@@ -1,0 +1,26 @@
+"""sparksched_tpu — a TPU-native (JAX/XLA) framework for DAG-job cluster
+scheduling simulation and RL training.
+
+Re-designed from scratch with the capabilities of
+`ArchieGertsman/gym-sparksched` (the "spark-sched-sim" reference, mounted at
+/root/reference), but built TPU-first:
+
+- the discrete-event Spark simulator is a pure function over a
+  struct-of-arrays, fixed-shape-padded environment state, so `jax.vmap` runs
+  thousands of parallel environments per chip and `jax.lax.scan` collects
+  whole trajectories on-device (reference: one Python object-graph env per
+  OS process, spark_sched_sim/spark_sched_sim.py);
+- the event heap (reference: components/event.py) becomes an argmin over
+  candidate event times with exact FIFO tie-breaking via sequence numbers;
+- the Decima GNN (reference: schedulers/decima/scheduler.py, PyTorch
+  Geometric) is a flax module whose level-wise DAG message passing runs as
+  batched dense matmuls on the MXU;
+- rollout workers + mp.Pipe (reference: trainers/) collapse into a single
+  jitted program: `vmap(policy . env_step)` under `lax.scan`, with PPO/VPG
+  losses computed on-device and `shard_map` scaling lanes across a device
+  mesh.
+"""
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401

@@ -1,0 +1,108 @@
+"""Static configuration for the vectorized simulator.
+
+The reference drives everything from a YAML file with three sections
+(`trainer`/`agent`/`env`; reference: config/decima_tpch.yaml, cfg_loader.py).
+We keep that YAML shape for drop-in familiarity, but the environment's shape
+caps must be static so XLA sees fixed shapes: `EnvParams` is a frozen,
+hashable dataclass that is passed as a `static_argnum` to jitted functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from argparse import ArgumentDefaultsHelpFormatter, ArgumentParser
+from typing import Any
+
+import yaml
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvParams:
+    """Static environment parameters (all shape-determining fields).
+
+    Mirrors the reference env config (spark_sched_sim/spark_sched_sim.py:34-57)
+    plus the padding caps the reference does not need because it uses dynamic
+    Python object graphs.
+    """
+
+    # number of simulated executors (reference spark_sched_sim.py:37)
+    num_executors: int = 10
+
+    # hard cap on job arrivals == padded job axis. The reference allows a
+    # time-limit-only episode (spark_sched_sim.py:48); with fixed shapes a
+    # cap is always required.
+    max_jobs: int = 50
+
+    # padded per-job stage axis (TPC-H DAGs have <= ~20 stages)
+    max_stages: int = 20
+
+    # cap on DAG depth for the level-wise GNN scan; topological depth of a
+    # DAG with max_stages nodes is at most max_stages.
+    max_levels: int = 20
+
+    # time in ms for an executor to move between jobs (reference :40)
+    moving_delay: float = 2000.0
+
+    # warmup delay in ms added to some first-wave task durations
+    # (reference data_samplers/tpch.py:38-43)
+    warmup_delay: float = 1000.0
+
+    # continuous discount factor for rewards (reference :42-44)
+    beta: float = 0.0
+
+    # Poisson job arrival rate (1/ms); inverse is mean inter-arrival time
+    # (reference data_samplers/tpch.py:29-32)
+    job_arrival_rate: float = 4.0e-5
+
+    # mean of the exponential per-episode time limit (ms). None => no time
+    # limit (episode ends when all `max_jobs` jobs complete).
+    # (reference wrappers/stochastic_time_limit.py)
+    mean_time_limit: float | None = None
+
+    # track per-executor release history on-device for Gantt rendering
+    # (reference components/executor.py:20-26). 0 disables.
+    history_cap: int = 0
+
+    @property
+    def num_nodes(self) -> int:
+        return self.max_jobs * self.max_stages
+
+    def replace(self, **kw: Any) -> "EnvParams":
+        return dataclasses.replace(self, **kw)
+
+
+def env_params_from_cfg(env_cfg: dict[str, Any]) -> EnvParams:
+    """Build EnvParams from a reference-style `env:` config section."""
+    known = {f.name for f in dataclasses.fields(EnvParams)}
+    kw = {k: v for k, v in env_cfg.items() if k in known}
+    if "max_jobs" not in kw and "job_arrival_cap" in env_cfg:
+        kw["max_jobs"] = int(env_cfg["job_arrival_cap"])
+    if "mean_time_limit" in env_cfg and "job_arrival_cap" not in env_cfg:
+        # time-limit-only episodes still need a padding cap
+        kw.setdefault("max_jobs", 200)
+    return EnvParams(**kw)
+
+
+def load(filename: str | None = None) -> dict[str, Any]:
+    """Load a YAML experiment config (reference cfg_loader.py:5-13)."""
+    if not filename:
+        args = make_parser().parse_args()
+        filename = args.filename
+    with open(filename, "r") as stream:
+        return yaml.safe_load(stream)
+
+
+def make_parser() -> ArgumentParser:
+    parser = ArgumentParser(
+        description="sparksched_tpu experiment runner",
+        formatter_class=ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument(
+        "-f",
+        "--file",
+        dest="filename",
+        help="experiment definition file",
+        metavar="FILE",
+        required=True,
+    )
+    return parser

@@ -1,0 +1,3 @@
+from .core import find_schedulable, reset, step  # noqa: F401
+from .observe import NUM_NODE_FEATURES, Observation, observe  # noqa: F401
+from .state import EnvState, empty_state  # noqa: F401

@@ -1,0 +1,765 @@
+"""The vectorized Spark scheduling simulator: pure reset/step functions.
+
+Semantics mirror the reference `SparkSchedSimEnv`
+(spark_sched_sim/spark_sched_sim.py) exactly — commitment rounds, executor
+pools, backup scheduling, moving delays, wave-based task durations — but the
+implementation is a branch-free-per-lane state machine over the SoA
+`EnvState`, so `jax.vmap(step)` advances thousands of simulations per TPU
+core and `lax.while_loop` replaces the Python event loop.
+
+Action encoding: `stage_idx` is a *flat padded node index* j * max_stages + s
+(or -1 for "no selection"), unlike the reference's index into the compacted
+list of schedulable stages (spark_sched_sim.py:284). Adapters convert.
+`num_exec` is 1-based like the raw reference env (1..num_executors).
+
+Invalid actions (unschedulable stage, out-of-range executor counts) are
+handled by clamping — selecting an unschedulable stage behaves like -1 and
+executor counts are clipped to [1, num_committable] — where the reference
+raises ValueError (:275-295). Under jit there is no raising; policies are
+expected to respect the masks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import EnvParams
+from ..workload.bank import WorkloadBank
+from ..workload.sampling import sample_job_sequence, sample_task_duration
+from .state import (
+    BIG_SEQ,
+    EV_EXECUTOR_READY,
+    EV_JOB_ARRIVAL,
+    EV_TASK_FINISHED,
+    INF,
+    EnvState,
+    empty_state,
+)
+
+_i32 = jnp.int32
+
+
+def _onehot(n: int, e: jnp.ndarray) -> jnp.ndarray:
+    return jnp.arange(n) == e
+
+
+# --------------------------------------------------------------------------
+# schedulable-stage computation (reference :505-555)
+# --------------------------------------------------------------------------
+
+
+def find_schedulable(
+    params: EnvParams, state: EnvState, source_job_id: jnp.ndarray
+) -> jnp.ndarray:
+    """bool[J,S]. A stage is schedulable iff its job passes the saturation
+    filter (source job exempt), it is ready (unsaturated with all parents
+    saturated), and it was not selected this round."""
+    j_idx = jnp.arange(params.max_jobs)
+    job_ok = state.job_active & (
+        (j_idx == source_job_id)
+        | (state.job_supply < params.num_executors)
+    )
+    sat = state.stage_saturated
+    parent_unsat = (state.adj & (~sat & state.stage_exists)[:, :, None]).any(
+        axis=1
+    )
+    ready = state.stage_exists & ~sat & ~parent_unsat
+    return job_ok[:, None] & ready & ~state.stage_selected
+
+
+# --------------------------------------------------------------------------
+# executor pool moves (reference executor_tracker + spark_sched_sim helpers)
+# --------------------------------------------------------------------------
+
+
+def _move_idle_from_pool(
+    state: EnvState, pj: jnp.ndarray, ps: jnp.ndarray, mask: jnp.ndarray
+) -> EnvState:
+    """_move_idle_executors (reference :745-782): no-op for the common pool
+    and for unsaturated job pools; otherwise masked executors move to the
+    common pool (job saturated — detaching them) or to the job pool (task
+    reference intentionally retained, matching the reference's
+    move_executor_to_pool which does not clear `executor.task`)."""
+    sat = state.job_saturated[jnp.maximum(pj, 0)]
+    noop = (pj < 0) | ((ps < 0) & ~sat)
+    m = mask & ~noop
+    to_common = m & sat
+    return state.replace(
+        exec_at_common=jnp.where(to_common, True, state.exec_at_common),
+        exec_job=jnp.where(to_common, -1, state.exec_job),
+        exec_stage=jnp.where(m, -1, state.exec_stage),
+        exec_task_valid=jnp.where(
+            to_common, False, state.exec_task_valid
+        ),
+    )
+
+
+def _exec_location(state: EnvState, e: jnp.ndarray):
+    """Pool key of executor e: (-1,-1) for common; (job, stage|-1) else."""
+    pj = jnp.where(state.exec_at_common[e], -1, state.exec_job[e])
+    ps = jnp.where(state.exec_at_common[e], -1, state.exec_stage[e])
+    return pj, ps
+
+
+# --------------------------------------------------------------------------
+# task execution (reference :584-615)
+# --------------------------------------------------------------------------
+
+
+def _execute_next_task(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    e: jnp.ndarray, j: jnp.ndarray, s: jnp.ndarray
+) -> EnvState:
+    rng, sub = jax.random.split(state.rng)
+    tpl = state.job_template[j]
+    num_local = (state.exec_job == j).sum()  # len(job.local_executors)
+    same_stage = state.exec_task_stage[e] == s
+    dur = sample_task_duration(
+        params, bank, sub, tpl, s, num_local,
+        state.exec_task_valid[e], same_stage,
+    )
+    seq = state.seq_counter
+    newly_saturated = state.stage_remaining[j, s] == 1
+    return state.replace(
+        rng=rng,
+        seq_counter=seq + 1,
+        stage_remaining=state.stage_remaining.at[j, s].add(-1),
+        stage_executing=state.stage_executing.at[j, s].add(1),
+        stage_duration=state.stage_duration.at[j, s].set(dur),
+        job_saturated_stages=state.job_saturated_stages.at[j].add(
+            newly_saturated.astype(_i32)
+        ),
+        exec_executing=state.exec_executing.at[e].set(True),
+        exec_task_valid=state.exec_task_valid.at[e].set(True),
+        exec_task_stage=state.exec_task_stage.at[e].set(s),
+        exec_finish_time=state.exec_finish_time.at[e].set(
+            state.wall_time + dur
+        ),
+        exec_finish_seq=state.exec_finish_seq.at[e].set(seq),
+    )
+
+
+def _send_executor(
+    params: EnvParams, state: EnvState, e: jnp.ndarray,
+    j: jnp.ndarray, s: jnp.ndarray
+) -> EnvState:
+    """reference :617-637 — detach, mark moving, push EXECUTOR_READY."""
+    old_job = state.exec_job[e]
+    seq = state.seq_counter
+    supply = state.job_supply.at[j].add(1)
+    supply = supply.at[jnp.maximum(old_job, 0)].add(
+        jnp.where(old_job >= 0, -1, 0)
+    )
+    return state.replace(
+        seq_counter=seq + 1,
+        job_supply=supply,
+        exec_at_common=state.exec_at_common.at[e].set(False),
+        exec_job=state.exec_job.at[e].set(-1),
+        exec_stage=state.exec_stage.at[e].set(-1),
+        exec_task_valid=state.exec_task_valid.at[e].set(False),
+        exec_moving=state.exec_moving.at[e].set(True),
+        exec_dst_job=state.exec_dst_job.at[e].set(j),
+        exec_dst_stage=state.exec_dst_stage.at[e].set(s),
+        exec_arrive_time=state.exec_arrive_time.at[e].set(
+            state.wall_time + params.moving_delay
+        ),
+        exec_arrive_seq=state.exec_arrive_seq.at[e].set(seq),
+    )
+
+
+# --------------------------------------------------------------------------
+# backup scheduling (reference :784-845)
+# --------------------------------------------------------------------------
+
+
+def _find_backup_stage(params: EnvParams, state: EnvState, e: jnp.ndarray):
+    """Greedy local-then-global search for a stage to absorb an executor
+    that arrived somewhere it is no longer needed. Reproduces the
+    reference's `if not source_job_id` falsiness quirk (:521-522): when the
+    executor's job id is 0, the saturation-filter exemption falls back to
+    the tracker's current source job."""
+    own = state.exec_job[e]
+    eff_src = jnp.where(own == 0, state.source_job_id(), own)
+    sched = find_schedulable(params, state, eff_src)
+    j_cap, s_cap = sched.shape
+    flat = sched.reshape(-1)
+    pos = jnp.arange(j_cap * s_cap)
+    job_of = pos // s_cap
+
+    local = flat & (job_of == own)
+    other = flat & (job_of != own)
+
+    local_any = local.any()
+    local_idx = jnp.argmax(local)
+    other_any = other.any()
+    other_idx = jnp.argmax(other)
+
+    found = local_any | other_any
+    idx = jnp.where(local_any, local_idx, other_idx)
+    return found, idx // s_cap, idx % s_cap
+
+
+# --------------------------------------------------------------------------
+# executor -> stage movement (reference :799-819), with the backup layer
+# --------------------------------------------------------------------------
+
+
+def _mets_inner(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    e: jnp.ndarray, j: jnp.ndarray, s: jnp.ndarray
+) -> EnvState:
+    """_move_executor_to_stage for a stage known to have remaining tasks."""
+
+    def do_send(st: EnvState) -> EnvState:
+        return _send_executor(params, st, e, j, s)
+
+    def local(st: EnvState) -> EnvState:
+        def not_frontier(st: EnvState) -> EnvState:
+            # stage not ready yet: idle the executor in the job pool
+            return st.replace(
+                exec_task_valid=st.exec_task_valid.at[e].set(False),
+                exec_stage=st.exec_stage.at[e].set(-1),
+            )
+
+        def start(st: EnvState) -> EnvState:
+            st = st.replace(exec_stage=st.exec_stage.at[e].set(s))
+            return _execute_next_task(params, bank, st, e, j, s)
+
+        return lax.cond(st.frontier[j, s], start, not_frontier, st)
+
+    return lax.cond(state.exec_job[e] != j, do_send, local, state)
+
+
+def _move_executor_to_stage(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    e: jnp.ndarray, j: jnp.ndarray, s: jnp.ndarray
+) -> EnvState:
+    def saturated_path(st: EnvState) -> EnvState:
+        found, bj, bs = _find_backup_stage(params, st, e)
+
+        def backup(st: EnvState) -> EnvState:
+            # a schedulable backup stage is necessarily unsaturated, hence
+            # has remaining tasks: no second backup hop can occur
+            return _mets_inner(params, bank, st, e, bj, bs)
+
+        def idle(st: EnvState) -> EnvState:
+            pj, ps = _exec_location(st, e)
+            n = st.exec_job.shape[0]
+            return _move_idle_from_pool(st, pj, ps, _onehot(n, e))
+
+        return lax.cond(found, backup, idle, st)
+
+    def normal(st: EnvState) -> EnvState:
+        return _mets_inner(params, bank, st, e, j, s)
+
+    return lax.cond(
+        state.stage_remaining[j, s] == 0, saturated_path, normal, state
+    )
+
+
+# --------------------------------------------------------------------------
+# commitments (reference executor_tracker.py:146-249)
+# --------------------------------------------------------------------------
+
+
+def _add_commitment(
+    state: EnvState, n: jnp.ndarray, dj: jnp.ndarray, ds: jnp.ndarray
+) -> EnvState:
+    """Create n commitment slots from the current source pool to (dj, ds).
+    Slots for an existing (src, dst) pair inherit its sequence number so
+    `peek` preserves the reference's dict-insertion order."""
+    src_j, src_s = state.source_job, state.source_stage
+    match = (
+        state.cm_valid
+        & (state.cm_src_job == src_j)
+        & (state.cm_src_stage == src_s)
+        & (state.cm_dst_job == dj)
+        & (state.cm_dst_stage == ds)
+    )
+    has_match = match.any()
+    inherited = jnp.where(match, state.cm_seq, BIG_SEQ).min()
+    seq = jnp.where(has_match, inherited, state.seq_counter)
+
+    free = ~state.cm_valid
+    take = free & (jnp.cumsum(free.astype(_i32)) <= n)
+
+    supply_delta = jnp.where((dj >= 0) & (dj != src_j), n, 0)
+    supply = state.job_supply.at[jnp.maximum(dj, 0)].add(supply_delta)
+
+    return state.replace(
+        seq_counter=state.seq_counter + jnp.where(has_match, 0, 1),
+        job_supply=supply,
+        cm_valid=state.cm_valid | take,
+        cm_src_job=jnp.where(take, src_j, state.cm_src_job),
+        cm_src_stage=jnp.where(take, src_s, state.cm_src_stage),
+        cm_dst_job=jnp.where(take, dj, state.cm_dst_job),
+        cm_dst_stage=jnp.where(take, ds, state.cm_dst_stage),
+        cm_seq=jnp.where(take, seq, state.cm_seq),
+    )
+
+
+def _commit_remaining(state: EnvState) -> EnvState:
+    """reference :487-503 — commit uncommitted source executors to the
+    common pool."""
+    n = state.num_committable()
+    return lax.cond(
+        n > 0,
+        lambda st: _add_commitment(st, n, _i32(-1), _i32(-1)),
+        lambda st: st,
+        state,
+    )
+
+
+def _peek_commitment(state: EnvState, pj: jnp.ndarray, ps: jnp.ndarray):
+    """First outgoing commitment from pool (pj, ps) in insertion order
+    (reference executor_tracker.py:175-181). Returns (exists, slot)."""
+    match = (
+        state.cm_valid
+        & (state.cm_src_job == pj)
+        & (state.cm_src_stage == ps)
+    )
+    key = jnp.where(match, state.cm_seq, BIG_SEQ)
+    return match.any(), jnp.argmin(key)
+
+
+def _fulfill_commitment(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    e: jnp.ndarray, slot: jnp.ndarray
+) -> EnvState:
+    """reference :699-712 — consume one commitment slot with executor e."""
+    dj = state.cm_dst_job[slot]
+    ds = state.cm_dst_stage[slot]
+    sj = state.cm_src_job[slot]
+    supply_delta = jnp.where((dj >= 0) & (dj != sj), -1, 0)
+    state = state.replace(
+        cm_valid=state.cm_valid.at[slot].set(False),
+        job_supply=state.job_supply.at[jnp.maximum(dj, 0)].add(supply_delta),
+    )
+
+    def to_common(st: EnvState) -> EnvState:
+        pj, ps = _exec_location(st, e)
+        n = st.exec_job.shape[0]
+        return _move_idle_from_pool(st, pj, ps, _onehot(n, e))
+
+    def to_stage(st: EnvState) -> EnvState:
+        return _move_executor_to_stage(params, bank, st, e, dj, ds)
+
+    return lax.cond(dj < 0, to_common, to_stage, state)
+
+
+def _fulfill_from_source(
+    params: EnvParams, bank: WorkloadBank, state: EnvState
+) -> EnvState:
+    """reference :730-743 — match the source pool's idle executors against
+    its outstanding commitments, in commitment insertion order."""
+    n = state.exec_job.shape[0]
+    idle = state.source_pool_mask() & ~state.exec_executing
+    num_idle = idle.sum()
+
+    exec_order = jnp.argsort(jnp.where(idle, jnp.arange(n), BIG_SEQ))
+    match = (
+        state.cm_valid
+        & (state.cm_src_job == state.source_job)
+        & (state.cm_src_stage == state.source_stage)
+    )
+    slot_order = jnp.argsort(
+        jnp.where(match, state.cm_seq, BIG_SEQ), stable=True
+    )
+
+    def body(k, st: EnvState) -> EnvState:
+        def do(st: EnvState) -> EnvState:
+            return _fulfill_commitment(
+                params, bank, st, exec_order[k], slot_order[k]
+            )
+
+        return lax.cond(k < num_idle, do, lambda s: s, st)
+
+    return lax.fori_loop(0, n, body, state)
+
+
+# --------------------------------------------------------------------------
+# node levels for the GNN (active-subgraph topological generations)
+# --------------------------------------------------------------------------
+
+
+def recompute_job_levels(state: EnvState, j: jnp.ndarray) -> jnp.ndarray:
+    """i32[S]: topological generation of each active stage of job j within
+    the active subgraph (completed stages excluded), padding = S. Matches
+    nx.topological_generations on the observed dag batch (reference
+    decima/utils.py:238-267)."""
+    s_cap = state.stage_exists.shape[1]
+    active = state.stage_exists[j] & ~state.stage_completed[j]
+    adj_act = state.adj[j] & active[:, None] & active[None, :]
+
+    def body(_, lvl):
+        cand = jnp.where(adj_act, lvl[:, None] + 1, 0).max(axis=0)
+        return jnp.maximum(lvl, cand)
+
+    lvl = lax.fori_loop(0, s_cap, body, jnp.zeros(s_cap, _i32))
+    return jnp.where(active, lvl, s_cap)
+
+
+# --------------------------------------------------------------------------
+# event handlers (reference :426-483)
+# --------------------------------------------------------------------------
+
+
+def _handle_job_arrival(
+    params: EnvParams, bank: WorkloadBank, state: EnvState, j: jnp.ndarray
+) -> EnvState:
+    state = state.replace(job_arrived=state.job_arrived.at[j].set(True))
+    has_common = state.exec_at_common.any()
+    return state.replace(
+        source_valid=state.source_valid | has_common,
+        source_job=jnp.where(has_common, -1, state.source_job),
+        source_stage=jnp.where(has_common, -1, state.source_stage),
+    )
+
+
+def _handle_executor_ready(
+    params: EnvParams, bank: WorkloadBank, state: EnvState, e: jnp.ndarray
+) -> EnvState:
+    j = state.exec_dst_job[e]
+    s = state.exec_dst_stage[e]
+    state = state.replace(
+        exec_moving=state.exec_moving.at[e].set(False),
+        exec_arrive_time=state.exec_arrive_time.at[e].set(INF),
+        exec_at_common=state.exec_at_common.at[e].set(False),
+        exec_job=state.exec_job.at[e].set(j),
+        exec_stage=state.exec_stage.at[e].set(-1),
+    )
+    return _move_executor_to_stage(params, bank, state, e, j, s)
+
+
+def _handle_task_finished(
+    params: EnvParams, bank: WorkloadBank, state: EnvState, e: jnp.ndarray
+) -> EnvState:
+    j = state.exec_job[e]
+    s = state.exec_task_stage[e]
+    n = state.exec_job.shape[0]
+    frontier_before = state.frontier[j]
+
+    state = state.replace(
+        stage_executing=state.stage_executing.at[j, s].add(-1),
+        stage_completed_tasks=state.stage_completed_tasks.at[j, s].add(1),
+        exec_executing=state.exec_executing.at[e].set(False),
+        exec_finish_time=state.exec_finish_time.at[e].set(INF),
+    )
+
+    def more_tasks(st: EnvState) -> EnvState:
+        return _execute_next_task(params, bank, st, e, j, s)
+
+    def released(st: EnvState) -> EnvState:
+        stage_done = st.stage_completed[j, s]
+        new_frontier = st.frontier[j] & ~frontier_before
+        did_change = stage_done & new_frontier.any()
+        job_done = st.job_completed[j]
+
+        def complete_job(st: EnvState) -> EnvState:
+            pool = st.pool_member_mask(j, _i32(-1)) & ~st.exec_executing
+            st = _move_idle_from_pool(st, j, _i32(-1), pool)
+            return st.replace(
+                job_t_completed=st.job_t_completed.at[j].set(st.wall_time)
+            )
+
+        st = lax.cond(
+            job_done & jnp.isinf(st.job_t_completed[j]),
+            complete_job, lambda s2: s2, st,
+        )
+
+        # the active subgraph changed: refresh job j's topological levels
+        st = lax.cond(
+            stage_done,
+            lambda s2: s2.replace(
+                node_level=s2.node_level.at[j].set(
+                    recompute_job_levels(s2, j)
+                )
+            ),
+            lambda s2: s2,
+            st,
+        )
+
+        has_cm, slot = _peek_commitment(st, j, s)
+
+        def fulfill(st: EnvState) -> EnvState:
+            return _fulfill_commitment(params, bank, st, e, slot)
+
+        def no_cm(st: EnvState) -> EnvState:
+            st = st.replace(
+                exec_task_valid=st.exec_task_valid.at[e].set(False)
+            )
+            return lax.cond(
+                did_change,
+                lambda s2: _move_idle_from_pool(s2, j, s, _onehot(n, e)),
+                lambda s2: s2,
+                st,
+            )
+
+        st = lax.cond(has_cm, fulfill, no_cm, st)
+
+        # _update_executor_source (reference :662-674)
+        set_job_pool = did_change
+        set_stage_pool = ~did_change & ~has_cm
+        any_set = set_job_pool | set_stage_pool
+        return st.replace(
+            source_valid=st.source_valid | any_set,
+            source_job=jnp.where(any_set, j, st.source_job),
+            source_stage=jnp.where(
+                set_job_pool, -1,
+                jnp.where(set_stage_pool, s, st.source_stage),
+            ),
+        )
+
+    return lax.cond(
+        state.stage_remaining[j, s] > 0, more_tasks, released, state
+    )
+
+
+# --------------------------------------------------------------------------
+# event selection + simulation loop (reference :320-343 + event.py)
+# --------------------------------------------------------------------------
+
+
+def _next_event(params: EnvParams, state: EnvState):
+    """Lexicographic (time, seq) argmin over all pending events."""
+    t_job = jnp.where(state.job_arrived, INF, state.job_arrival_time)
+    times = jnp.concatenate(
+        [t_job, state.exec_finish_time, state.exec_arrive_time]
+    )
+    seqs = jnp.concatenate(
+        [state.job_arrival_seq, state.exec_finish_seq,
+         state.exec_arrive_seq]
+    )
+    tmin = times.min()
+    has = jnp.isfinite(tmin)
+    cand = times == tmin
+    idx = jnp.argmin(jnp.where(cand, seqs, BIG_SEQ))
+    j_cap = params.max_jobs
+    n = params.num_executors
+    kind = jnp.where(
+        idx < j_cap,
+        EV_JOB_ARRIVAL,
+        jnp.where(idx < j_cap + n, EV_TASK_FINISHED, EV_EXECUTOR_READY),
+    )
+    arg = jnp.where(
+        idx < j_cap,
+        idx,
+        jnp.where(idx < j_cap + n, idx - j_cap, idx - j_cap - n),
+    )
+    return has, tmin, kind, arg
+
+
+def _resume_simulation(
+    params: EnvParams, bank: WorkloadBank, state: EnvState
+) -> EnvState:
+    """Pop events until there are new scheduling decisions to make or the
+    queue drains (reference :320-343)."""
+
+    def cond(st: EnvState) -> jnp.ndarray:
+        has, _, _, _ = _next_event(params, st)
+        return has & ~st.round_ready
+
+    def body(st: EnvState) -> EnvState:
+        _, t, kind, arg = _next_event(params, st)
+        st = st.replace(wall_time=t)
+        st = lax.switch(
+            kind,
+            [
+                lambda st, a: _handle_job_arrival(params, bank, st, a),
+                lambda st, a: _handle_task_finished(params, bank, st, a),
+                lambda st, a: _handle_executor_ready(params, bank, st, a),
+            ],
+            st,
+            arg,
+        )
+        committable = st.num_committable()
+        sched = find_schedulable(params, st, st.source_job_id())
+        ready = (committable > 0) & sched.any()
+
+        def set_ready(st: EnvState) -> EnvState:
+            return st.replace(
+                round_ready=jnp.bool_(True), schedulable=sched
+            )
+
+        def not_ready(st: EnvState) -> EnvState:
+            def move_and_clear(st: EnvState) -> EnvState:
+                idle = st.source_pool_mask() & ~st.exec_executing
+                st = _move_idle_from_pool(
+                    st, st.source_job, st.source_stage, idle
+                )
+                return st.replace(
+                    source_valid=jnp.bool_(False),
+                    source_job=_i32(-1),
+                    source_stage=_i32(-1),
+                )
+
+            return lax.cond(
+                committable > 0, move_and_clear, lambda s2: s2, st
+            )
+
+        return lax.cond(ready, set_ready, not_ready, st)
+
+    return lax.while_loop(cond, body, state)
+
+
+# --------------------------------------------------------------------------
+# reward (reference :847-874)
+# --------------------------------------------------------------------------
+
+
+def _compute_jobtime(
+    params: EnvParams, state: EnvState, t_old: jnp.ndarray,
+    active_old: jnp.ndarray
+) -> jnp.ndarray:
+    t_new = state.wall_time
+    m = active_old | state.job_active
+    start = jnp.maximum(state.job_arrival_time, t_old)
+    end = jnp.minimum(state.job_t_completed, t_new)
+    if params.beta == 0.0:
+        per = end - start
+    else:
+        b = params.beta * 1e-3
+        per = jnp.exp(-b * (start - t_old)) - jnp.exp(-b * (end - t_old))
+    total = jnp.where(m, per, 0.0).sum()
+    if params.beta > 0.0:
+        total = total / params.beta
+    return jnp.where(t_new == t_old, 0.0, total)
+
+
+# --------------------------------------------------------------------------
+# public API: reset / step
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=0)
+def reset(params: EnvParams, bank: WorkloadBank, rng: jax.Array) -> EnvState:
+    """Sample a fresh episode (reference :127-186 + StochasticTimeLimit)."""
+    k_limit, k_seq, k_state = jax.random.split(rng, 3)
+
+    if params.mean_time_limit is None:
+        time_limit = INF
+    else:
+        time_limit = (
+            jax.random.exponential(k_limit) * params.mean_time_limit
+        ).astype(jnp.float32)
+
+    arrivals, templates, num_jobs, mask = sample_job_sequence(
+        params, bank, k_seq, time_limit
+    )
+    return reset_from_sequence(
+        params, bank, k_state, time_limit, arrivals, templates, num_jobs,
+        mask,
+    )
+
+
+@partial(jax.jit, static_argnums=0)
+def reset_from_sequence(
+    params: EnvParams, bank: WorkloadBank, rng: jax.Array,
+    time_limit: jnp.ndarray, arrivals: jnp.ndarray, templates: jnp.ndarray,
+    num_jobs: jnp.ndarray, mask: jnp.ndarray
+) -> EnvState:
+    """Reset with an explicitly provided job sequence (for parity tests and
+    replay; the reference takes its sequence from DataSampler.job_sequence
+    at reset, spark_sched_sim.py:149-156)."""
+    state = empty_state(params, rng)
+    s_cap = params.max_stages
+    ns = jnp.where(mask, bank.num_stages[templates], 0)
+    exists = (jnp.arange(s_cap)[None, :] < ns[:, None])
+    ntasks = jnp.where(exists, bank.num_tasks[templates], 0)
+    rough = jnp.where(exists, bank.rough_duration[templates], 0.0)
+    adj = bank.adj[templates] & exists[:, :, None] & exists[:, None, :]
+
+    state = state.replace(
+        time_limit=time_limit,
+        seq_counter=num_jobs,
+        job_template=templates,
+        job_arrival_time=arrivals,
+        job_arrival_seq=jnp.arange(params.max_jobs, dtype=_i32),
+        job_num_stages=ns,
+        num_jobs=num_jobs,
+        stage_exists=exists,
+        stage_num_tasks=ntasks,
+        stage_remaining=ntasks,
+        stage_duration=rough,
+        adj=adj,
+        node_level=jnp.where(
+            exists, bank.node_level[templates], s_cap
+        ).astype(_i32),
+    )
+
+    # _load_initial_jobs (reference :260-273): pop all t=0 arrivals
+    t0 = mask & (arrivals == 0.0)
+    state = state.replace(
+        job_arrived=t0,
+        # common pool holds all executors -> source = common pool
+        source_valid=jnp.bool_(True),
+        source_job=_i32(-1),
+        source_stage=_i32(-1),
+    )
+    sched = find_schedulable(params, state, state.source_job_id())
+    return state.replace(schedulable=sched, round_ready=jnp.bool_(True))
+
+
+@partial(jax.jit, static_argnums=0)
+def step(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    stage_idx: jnp.ndarray, num_exec: jnp.ndarray
+):
+    """One decision step (reference :188-221). Returns
+    (state, reward, terminated, truncated)."""
+    s_cap = params.max_stages
+    j = stage_idx // s_cap
+    s = stage_idx % s_cap
+    valid = (
+        (stage_idx >= 0)
+        & (stage_idx < params.num_nodes)
+        & state.schedulable[j, s]
+    )
+
+    def do_commit(st: EnvState) -> EnvState:
+        committable = st.num_committable()
+        n = jnp.clip(num_exec, 1, committable)
+        n = jnp.minimum(n, st.exec_demand[j, s])  # _adjust_num_executors
+        st = _add_commitment(st, n, j, s)
+        st = st.replace(
+            stage_selected=st.stage_selected.at[j, s].set(True)
+        )
+        sched = find_schedulable(params, st, st.source_job_id())
+        return st.replace(schedulable=sched)
+
+    state = lax.cond(valid, do_commit, _commit_remaining, state)
+
+    round_continues = (state.num_committable() > 0) & state.schedulable.any()
+
+    def continue_round(st: EnvState):
+        return st, jnp.float32(0.0)
+
+    def finish_round(st: EnvState):
+        st = _commit_remaining(st)
+        st = _fulfill_from_source(params, bank, st)
+        st = st.replace(
+            source_valid=jnp.bool_(False),
+            source_job=_i32(-1),
+            source_stage=_i32(-1),
+            stage_selected=jnp.zeros_like(st.stage_selected),
+            round_ready=jnp.bool_(False),
+            schedulable=jnp.zeros_like(st.schedulable),
+        )
+        t_old = st.wall_time
+        active_old = st.job_active
+        st = _resume_simulation(params, bank, st)
+        reward = -_compute_jobtime(params, st, t_old, active_old)
+        return st, reward
+
+    state, reward = lax.cond(
+        round_continues, continue_round, finish_round, state
+    )
+
+    terminated = state.all_jobs_complete
+    truncated = state.wall_time >= state.time_limit
+    state = state.replace(terminated=terminated, truncated=truncated)
+    return state, reward, terminated, truncated

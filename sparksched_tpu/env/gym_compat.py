@@ -1,0 +1,180 @@
+"""Gymnasium-compatible single-environment adapter.
+
+Exposes the vectorized core through the reference's exact observation /
+action dict contract (spark_sched_sim.py:85-125), so code written against
+`ArchieGertsman/gym-sparksched` — heuristic schedulers, metrics, episode
+loops — runs unchanged on top of the TPU core. Also the bridge used by the
+golden parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+try:
+    import gymnasium as gym
+    import gymnasium.spaces as sp
+
+    _GYM = True
+except ImportError:  # pragma: no cover
+    _GYM = False
+
+import jax
+import jax.numpy as jnp
+
+from ..config import EnvParams, env_params_from_cfg
+from ..workload import WorkloadBank, make_workload_bank
+from . import core
+from .observe import NUM_NODE_FEATURES, Observation, observe
+
+
+def compact_obs(params: EnvParams, obs: Observation) -> dict[str, Any]:
+    """Convert a padded Observation into the reference's ragged obs dict."""
+    node_mask = np.asarray(obs.node_mask)
+    job_mask = np.asarray(obs.job_mask)
+    nodes_padded = np.asarray(obs.nodes)
+    adj = np.asarray(obs.adj)
+    supplies = np.asarray(obs.exec_supplies)
+
+    active_jobs = np.flatnonzero(job_mask)
+    nodes_list = []
+    dag_ptr = [0]
+    edge_links = []
+    exec_supplies = []
+    # flat padded index -> compact node index
+    compact_of: dict[int, int] = {}
+    s_cap = params.max_stages
+
+    for j in active_jobs:
+        stages = np.flatnonzero(node_mask[j])
+        for s in stages:
+            compact_of[int(j) * s_cap + int(s)] = len(nodes_list)
+            nodes_list.append(nodes_padded[j, s])
+        for p in stages:
+            for c in np.flatnonzero(adj[j, p] & node_mask[j]):
+                edge_links.append(
+                    [compact_of[int(j) * s_cap + int(p)],
+                     compact_of[int(j) * s_cap + int(c)]]
+                )
+        dag_ptr.append(len(nodes_list))
+        exec_supplies.append(int(supplies[j]))
+
+    nodes_arr = (
+        np.vstack(nodes_list).astype(np.float32)
+        if nodes_list
+        else np.zeros((0, NUM_NODE_FEATURES), dtype=np.float32)
+    )
+    edge_arr = (
+        np.array(sorted(edge_links), dtype=np.int64)
+        if edge_links
+        else np.zeros((0, 2), dtype=np.int64)
+    )
+
+    source_job = int(obs.source_job)
+    source_job_idx = len(active_jobs)
+    if source_job >= 0:
+        pos = np.flatnonzero(active_jobs == source_job)
+        if pos.size:
+            source_job_idx = int(pos[0])
+
+    return {
+        "dag_batch": _graph_instance(nodes_arr, edge_arr),
+        "dag_ptr": list(dag_ptr),
+        "num_committable_execs": int(obs.num_committable),
+        "source_job_idx": source_job_idx,
+        "exec_supplies": exec_supplies,
+        # extras used by adapters (not part of the reference dict)
+        "_active_jobs": active_jobs,
+        "_compact_of": compact_of,
+    }
+
+
+def _graph_instance(nodes: np.ndarray, edge_links: np.ndarray):
+    if _GYM:
+        return sp.GraphInstance(
+            nodes, np.zeros(len(edge_links), dtype=np.int64), edge_links
+        )
+    return {"nodes": nodes, "edge_links": edge_links}
+
+
+def schedulable_flat_indices(
+    params: EnvParams, obs: Observation
+) -> np.ndarray:
+    """Flat padded node indices of schedulable stages, in the reference's
+    enumeration order (active jobs by id, stages by id) — index k here
+    corresponds to reference action stage_idx == k
+    (spark_sched_sim.py:354-355)."""
+    sched = np.asarray(obs.schedulable)
+    return np.flatnonzero(sched.reshape(-1))
+
+
+class SparkSchedSimGymEnv(gym.Env if _GYM else object):
+    """Reference-compatible Gymnasium env backed by the jitted TPU core.
+
+    Action dict: {"stage_idx": index into the current schedulable list
+    (-1 = none), "num_exec": executors to commit} — the reference contract
+    (spark_sched_sim.py:85-94)."""
+
+    metadata = {"render_modes": ["human"]}
+
+    def __init__(self, env_cfg: dict[str, Any],
+                 bank: WorkloadBank | None = None) -> None:
+        self.params = env_params_from_cfg(env_cfg)
+        self.bank = bank if bank is not None else make_workload_bank(
+            self.params.num_executors, self.params.max_stages,
+            **{k: v for k, v in env_cfg.items()
+               if k in ("data_dir", "seed", "bucket_size")},
+        )
+        if self.bank.max_stages != self.params.max_stages:
+            # real traces may exceed the configured cap; the bank widens and
+            # the env params must follow (all shapes key off max_stages)
+            self.params = self.params.replace(
+                max_stages=self.bank.max_stages,
+                max_levels=max(self.params.max_levels,
+                               self.bank.max_stages),
+            )
+        self.state = None
+        self._obs: Observation | None = None
+        self._auto_seed = np.random.default_rng().integers(2**31)
+
+    @property
+    def wall_time(self) -> float:
+        return float(self.state.wall_time)
+
+    def reset(self, seed: int | None = None,
+              options: dict[str, Any] | None = None):
+        if _GYM:
+            super().reset(seed=seed)
+        if seed is None:
+            # gymnasium convention: fresh entropy on unseeded resets
+            self._auto_seed += 1
+            seed = int(self._auto_seed)
+        rng = jax.random.PRNGKey(seed)
+        self.state = core.reset(self.params, self.bank, rng)
+        self._obs = observe(self.params, self.state)
+        return compact_obs(self.params, self._obs), self._info()
+
+    def step(self, action: dict[str, Any]):
+        stage_idx = int(action["stage_idx"])
+        if stage_idx >= 0:
+            flat = schedulable_flat_indices(self.params, self._obs)
+            flat_idx = int(flat[stage_idx])
+        else:
+            flat_idx = -1
+        self.state, reward, term, trunc = core.step(
+            self.params, self.bank, self.state,
+            jnp.int32(flat_idx), jnp.int32(int(action["num_exec"])),
+        )
+        self._obs = observe(self.params, self.state)
+        return (
+            compact_obs(self.params, self._obs),
+            float(reward),
+            bool(term),
+            bool(trunc),
+            self._info(),
+        )
+
+    def _info(self) -> dict[str, Any]:
+        return {"wall_time": float(self.state.wall_time)}

@@ -1,0 +1,76 @@
+"""Padded observations from the environment state.
+
+The reference builds a ragged observation per step — variable-size node
+array, dag_ptr, dynamic gym spaces (spark_sched_sim.py:345-406). Here the
+observation is fixed-shape [max_jobs, max_stages] with masks, which is what
+lets the whole rollout stay on device. Adapters (env/gym_compat.py) compact
+it back to the reference layout for drop-in use."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import EnvParams
+from .state import EnvState
+
+NUM_NODE_FEATURES = 3  # reference spark_sched_sim.py:25
+
+
+class Observation(struct.PyTreeNode):
+    """Raw env observation (reference obs dict, spark_sched_sim.py:393-399),
+    padded. `nodes[..., :]` = (num_remaining_tasks, most_recent_duration,
+    is_schedulable) exactly as the reference's 3 node features."""
+
+    nodes: jnp.ndarray  # f32[J,S,3]
+    node_mask: jnp.ndarray  # bool[J,S]; active stages of active jobs
+    job_mask: jnp.ndarray  # bool[J]; active jobs
+    schedulable: jnp.ndarray  # bool[J,S]
+    frontier: jnp.ndarray  # bool[J,S]; no incoming edges in active subgraph
+    adj: jnp.ndarray  # bool[J,S,S]; template adjacency (mask with node_mask)
+    node_level: jnp.ndarray  # i32[J,S]; active-subgraph topo generation
+    exec_supplies: jnp.ndarray  # i32[J]
+    num_committable: jnp.ndarray  # i32 []
+    source_job: jnp.ndarray  # i32 []; job id, -1 = common pool or no source
+    wall_time: jnp.ndarray  # f32 []
+
+    @property
+    def num_active_jobs(self) -> jnp.ndarray:
+        return self.job_mask.sum().astype(jnp.int32)
+
+    @property
+    def num_active_nodes(self) -> jnp.ndarray:
+        return self.node_mask.sum().astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnums=0)
+def observe(params: EnvParams, state: EnvState) -> Observation:
+    job_mask = state.job_active
+    node_mask = (
+        job_mask[:, None] & state.stage_exists & ~state.stage_completed
+    )
+    nodes = jnp.stack(
+        [
+            state.stage_remaining.astype(jnp.float32),
+            state.stage_duration,
+            state.schedulable.astype(jnp.float32),
+        ],
+        axis=-1,
+    )
+    nodes = jnp.where(node_mask[:, :, None], nodes, 0.0)
+    return Observation(
+        nodes=nodes,
+        node_mask=node_mask,
+        job_mask=job_mask,
+        schedulable=state.schedulable & node_mask,
+        frontier=state.frontier & node_mask,
+        adj=state.adj,
+        node_level=state.node_level,
+        exec_supplies=jnp.where(job_mask, state.job_supply, 0),
+        num_committable=state.num_committable(),
+        source_job=state.source_job_id(),
+        wall_time=state.wall_time,
+    )

@@ -1,0 +1,291 @@
+"""Struct-of-arrays environment state.
+
+Replaces the reference's Python object graph (Job/Stage/Task/Executor +
+ExecutorTracker dicts + heapq event queue; reference spark_sched_sim/
+components/) with fixed-shape arrays so `jax.vmap` can run thousands of
+environments and `lax.while_loop` can drive the event loop on-device.
+
+Encoding conventions
+--------------------
+Pool keys (reference components/executor_tracker.py:4-10) become integer
+pairs: job == -1 means the common pool ("general pool"); stage == -1 means a
+job pool; (job >= 0, stage >= 0) is a stage pool. A separate validity flag
+stands in for the `None` placeholder pool.
+
+Events (reference components/event.py): instead of a heap, every pending
+event lives in the array that naturally owns it — job arrival times [J],
+per-executor task finish times [N], per-executor move arrival times [N] —
+each with the sequence number it was "pushed" with. The next event is the
+lexicographic argmin of (time, seq), which reproduces the reference heap's
+exact FIFO tie-breaking (event.py:34-35).
+
+Commitments (reference executor_tracker dict-of-dicts): a slot table of at
+most `num_executors` rows. This bound is exact: the tracker enforces
+supply >= demand per pool (executor_tracker.py:234-236) and pools partition
+the executors, so the total outstanding commitment count never exceeds N.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import EnvParams
+
+# event kinds, dispatch order matches reference handler registration
+# (spark_sched_sim.py:68-72)
+EV_JOB_ARRIVAL, EV_TASK_FINISHED, EV_EXECUTOR_READY = 0, 1, 2
+
+INF = jnp.float32(jnp.inf)
+BIG_SEQ = jnp.int32(2**30)
+
+
+class EnvState(struct.PyTreeNode):
+    # --- rng / time ---
+    rng: jnp.ndarray
+    wall_time: jnp.ndarray  # f32 []
+    time_limit: jnp.ndarray  # f32 []; inf if no time limit
+    seq_counter: jnp.ndarray  # i32 []; next event/commitment sequence number
+
+    # --- episode flags ---
+    round_ready: jnp.ndarray  # bool []; a scheduling round is in progress
+    terminated: jnp.ndarray  # bool []
+    truncated: jnp.ndarray  # bool []
+
+    # --- jobs [J] ---
+    job_template: jnp.ndarray  # i32[J]
+    job_arrival_time: jnp.ndarray  # f32[J]; inf for padding slots
+    job_arrival_seq: jnp.ndarray  # i32[J]
+    job_arrived: jnp.ndarray  # bool[J]
+    job_t_completed: jnp.ndarray  # f32[J]; inf until completed
+    job_num_stages: jnp.ndarray  # i32[J]
+    job_saturated_stages: jnp.ndarray  # i32[J] (reference job.py:41)
+    job_supply: jnp.ndarray  # i32[J]; _total_executor_count, maintained with
+    # the reference's exact increments (executor_tracker.py:146-231) —
+    # including its staleness for saturated jobs whose idle executors moved
+    # to the common pool without a decrement
+    num_jobs: jnp.ndarray  # i32 []; actual arrivals this episode
+
+    # --- stages [J,S] ---
+    stage_exists: jnp.ndarray  # bool[J,S]
+    stage_num_tasks: jnp.ndarray  # i32[J,S]
+    stage_remaining: jnp.ndarray  # i32[J,S]
+    stage_executing: jnp.ndarray  # i32[J,S]
+    stage_completed_tasks: jnp.ndarray  # i32[J,S]
+    stage_duration: jnp.ndarray  # f32[J,S]; most_recent_duration
+    stage_selected: jnp.ndarray  # bool[J,S]; selected this scheduling round
+    schedulable: jnp.ndarray  # bool[J,S]; saved schedulable set for round
+    adj: jnp.ndarray  # bool[J,S,S]; adj[j,p,c] == True iff edge p->c
+    node_level: jnp.ndarray  # i32[J,S]; topological generation of each
+    # active stage within the ACTIVE subgraph (completed stages excluded),
+    # padding = S. Maintained incrementally on stage completion — the
+    # vectorized equivalent of the reference Decima wrapper's cached
+    # edge-mask batches (schedulers/decima/env_wrapper.py:49-54,145-162)
+
+    # --- executors [N] ---
+    exec_at_common: jnp.ndarray  # bool[N]
+    exec_job: jnp.ndarray  # i32[N]; attached job, -1 = none (common/moving)
+    exec_stage: jnp.ndarray  # i32[N]; stage pool residence, -1 = none
+    exec_moving: jnp.ndarray  # bool[N]
+    exec_dst_job: jnp.ndarray  # i32[N]
+    exec_dst_stage: jnp.ndarray  # i32[N]
+    exec_arrive_time: jnp.ndarray  # f32[N]; inf if not moving
+    exec_arrive_seq: jnp.ndarray  # i32[N]
+    exec_executing: jnp.ndarray  # bool[N]
+    exec_task_valid: jnp.ndarray  # bool[N]; executor.task is not None
+    exec_task_stage: jnp.ndarray  # i32[N]; stage of current/last task
+    exec_finish_time: jnp.ndarray  # f32[N]; inf if not executing
+    exec_finish_seq: jnp.ndarray  # i32[N]
+
+    # --- commitment slots [N] ---
+    cm_valid: jnp.ndarray  # bool[N]
+    cm_src_job: jnp.ndarray  # i32[N]
+    cm_src_stage: jnp.ndarray  # i32[N]
+    cm_dst_job: jnp.ndarray  # i32[N]; -1 = common pool destination
+    cm_dst_stage: jnp.ndarray  # i32[N]
+    cm_seq: jnp.ndarray  # i32[N]
+
+    # --- executor source (reference executor_tracker _curr_source) ---
+    source_valid: jnp.ndarray  # bool []
+    source_job: jnp.ndarray  # i32 []; -1 = common pool
+    source_stage: jnp.ndarray  # i32 []
+
+    # ---------------- derived quantities ----------------
+
+    @property
+    def stage_completed(self) -> jnp.ndarray:
+        """bool[J,S]; a stage is completed when all its tasks completed
+        (reference components/stage.py:40)."""
+        return self.stage_exists & (
+            self.stage_completed_tasks >= self.stage_num_tasks
+        )
+
+    @property
+    def job_completed(self) -> jnp.ndarray:
+        """bool[J]; no incomplete stages remain (reference job.py:49-50)."""
+        done = jnp.where(self.stage_exists, self.stage_completed, True)
+        return self.job_arrived & done.all(axis=1)
+
+    @property
+    def job_active(self) -> jnp.ndarray:
+        """bool[J]; arrived and not completed == membership of
+        active_job_ids, which stays sorted by arrival order == job id."""
+        return self.job_arrived & ~self.job_completed
+
+    @property
+    def job_saturated(self) -> jnp.ndarray:
+        """bool[J] (reference job.py:53-54)."""
+        return self.job_saturated_stages >= self.job_num_stages
+
+    @property
+    def frontier(self) -> jnp.ndarray:
+        """bool[J,S]; incomplete stages whose parents all completed
+        (reference job.py:24-26, maintained incrementally there; derived
+        here). Identical to "no incoming edges in the active subgraph"
+        computed by heuristic preprocessing (schedulers/heuristics/
+        utils.py:5-14)."""
+        incomplete_parent = self.adj & ~self.stage_completed[:, :, None]
+        blocked = incomplete_parent.any(axis=1)
+        return self.stage_exists & ~self.stage_completed & ~blocked
+
+    @property
+    def commit_count_to_stage(self) -> jnp.ndarray:
+        """i32[J,S]; _num_commitments_to_stage, derived by scatter over
+        slots."""
+        j_cap, s_cap = self.stage_exists.shape
+        flat = jnp.zeros(j_cap * s_cap + 1, dtype=jnp.int32)
+        idx = jnp.where(
+            self.cm_valid & (self.cm_dst_job >= 0),
+            self.cm_dst_job * s_cap + self.cm_dst_stage,
+            j_cap * s_cap,
+        )
+        flat = flat.at[idx].add(1)
+        return flat[:-1].reshape(j_cap, s_cap)
+
+    @property
+    def moving_count_to_stage(self) -> jnp.ndarray:
+        """i32[J,S]; _num_moving_to_stage, derived from moving executors."""
+        j_cap, s_cap = self.stage_exists.shape
+        flat = jnp.zeros(j_cap * s_cap + 1, dtype=jnp.int32)
+        idx = jnp.where(
+            self.exec_moving,
+            self.exec_dst_job * s_cap + self.exec_dst_stage,
+            j_cap * s_cap,
+        )
+        flat = flat.at[idx].add(1)
+        return flat[:-1].reshape(j_cap, s_cap)
+
+    @property
+    def exec_demand(self) -> jnp.ndarray:
+        """i32[J,S]; remaining tasks minus (moving + committed) executors
+        (reference spark_sched_sim.py:566-578). Can be negative."""
+        return self.stage_remaining - (
+            self.moving_count_to_stage + self.commit_count_to_stage
+        )
+
+    @property
+    def stage_saturated(self) -> jnp.ndarray:
+        """bool[J,S] (reference :580-582)."""
+        return self.exec_demand <= 0
+
+    @property
+    def all_jobs_complete(self) -> jnp.ndarray:
+        j = jnp.arange(self.job_arrived.shape[0])
+        return jnp.where(j < self.num_jobs, self.job_completed, True).all()
+
+    # --- pools ---
+
+    def pool_member_mask(self, job: jnp.ndarray, stage: jnp.ndarray
+                         ) -> jnp.ndarray:
+        """bool[N]; executors residing in pool (job, stage)."""
+        common = self.exec_at_common
+        at_job_pool = (self.exec_job == job) & (self.exec_stage == -1) & \
+            ~self.exec_at_common & ~self.exec_moving
+        at_stage_pool = (self.exec_job == job) & (self.exec_stage == stage)
+        return jnp.where(
+            job < 0, common, jnp.where(stage < 0, at_job_pool, at_stage_pool)
+        )
+
+    def source_pool_mask(self) -> jnp.ndarray:
+        mask = self.pool_member_mask(self.source_job, self.source_stage)
+        return jnp.where(self.source_valid, mask, False)
+
+    def commitments_from_source(self) -> jnp.ndarray:
+        """i32 []; total outgoing commitments from the source pool."""
+        match = (
+            self.cm_valid
+            & (self.cm_src_job == self.source_job)
+            & (self.cm_src_stage == self.source_stage)
+        )
+        return jnp.where(self.source_valid, match.sum(), 0).astype(jnp.int32)
+
+    def num_committable(self) -> jnp.ndarray:
+        """i32 []; source pool size minus its outgoing commitments
+        (reference executor_tracker.py:105-111)."""
+        return (
+            self.source_pool_mask().sum().astype(jnp.int32)
+            - self.commitments_from_source()
+        )
+
+    def source_job_id(self) -> jnp.ndarray:
+        """i32 []; -1 when source is the common pool or cleared (the
+        reference returns None in both cases, executor_tracker.py:98-102)."""
+        return jnp.where(self.source_valid, self.source_job, -1)
+
+
+def empty_state(params: EnvParams, rng: jax.Array) -> EnvState:
+    """All-zero template state with the right shapes/dtypes."""
+    j, s, n = params.max_jobs, params.max_stages, params.num_executors
+    f32 = jnp.float32
+    i32 = jnp.int32
+    return EnvState(
+        rng=rng,
+        wall_time=f32(0),
+        time_limit=INF,
+        seq_counter=i32(0),
+        round_ready=jnp.bool_(False),
+        terminated=jnp.bool_(False),
+        truncated=jnp.bool_(False),
+        job_template=jnp.zeros(j, i32),
+        job_arrival_time=jnp.full(j, INF),
+        job_arrival_seq=jnp.zeros(j, i32),
+        job_arrived=jnp.zeros(j, bool),
+        job_t_completed=jnp.full(j, INF),
+        job_num_stages=jnp.zeros(j, i32),
+        job_saturated_stages=jnp.zeros(j, i32),
+        job_supply=jnp.zeros(j, i32),
+        num_jobs=i32(0),
+        stage_exists=jnp.zeros((j, s), bool),
+        stage_num_tasks=jnp.zeros((j, s), i32),
+        stage_remaining=jnp.zeros((j, s), i32),
+        stage_executing=jnp.zeros((j, s), i32),
+        stage_completed_tasks=jnp.zeros((j, s), i32),
+        stage_duration=jnp.zeros((j, s), f32),
+        stage_selected=jnp.zeros((j, s), bool),
+        schedulable=jnp.zeros((j, s), bool),
+        adj=jnp.zeros((j, s, s), bool),
+        node_level=jnp.full((j, s), s, i32),
+        exec_at_common=jnp.ones(n, bool),
+        exec_job=jnp.full(n, -1, i32),
+        exec_stage=jnp.full(n, -1, i32),
+        exec_moving=jnp.zeros(n, bool),
+        exec_dst_job=jnp.full(n, -1, i32),
+        exec_dst_stage=jnp.full(n, -1, i32),
+        exec_arrive_time=jnp.full(n, INF),
+        exec_arrive_seq=jnp.zeros(n, i32),
+        exec_executing=jnp.zeros(n, bool),
+        exec_task_valid=jnp.zeros(n, bool),
+        exec_task_stage=jnp.full(n, -1, i32),
+        exec_finish_time=jnp.full(n, INF),
+        exec_finish_seq=jnp.zeros(n, i32),
+        cm_valid=jnp.zeros(n, bool),
+        cm_src_job=jnp.full(n, -1, i32),
+        cm_src_stage=jnp.full(n, -1, i32),
+        cm_dst_job=jnp.full(n, -1, i32),
+        cm_dst_stage=jnp.full(n, -1, i32),
+        cm_seq=jnp.zeros(n, i32),
+        source_valid=jnp.bool_(False),
+        source_job=i32(-1),
+        source_stage=i32(-1),
+    )

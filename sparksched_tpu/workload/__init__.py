@@ -1,0 +1,32 @@
+import os.path as osp
+
+from .bank import (  # noqa: F401
+    EXEC_LEVEL_VALUES,
+    NUM_EXEC_LEVELS,
+    WorkloadBank,
+    load_tpch_templates,
+    pack_bank,
+)
+from .synthetic import make_templates  # noqa: F401
+
+
+def make_workload_bank(
+    num_executors: int,
+    max_stages: int = 20,
+    bucket_size: int = 16,
+    data_dir: str = "data/tpch",
+    seed: int = 2024,
+    data_sampler_cls: str | None = None,
+    **_: object,
+) -> WorkloadBank:
+    """Factory mirroring the reference `make_data_sampler`
+    (spark_sched_sim/data_samplers/__init__.py:9-15). Loads real TPC-H
+    traces when present on disk (the reference auto-downloads them,
+    tpch.py:109-115 — impossible without egress), else generates the
+    synthetic TPC-H-like bank."""
+    if osp.isdir(data_dir):
+        templates = load_tpch_templates(data_dir)
+        max_stages = max(max_stages, max(t["adj"].shape[0] for t in templates))
+    else:
+        templates = make_templates(seed=seed, bucket_size=bucket_size)
+    return pack_bank(templates, num_executors, max_stages, bucket_size)

@@ -1,0 +1,110 @@
+"""On-device workload sampling: Poisson job sequences and task durations.
+
+Replaces reference tpch.py:54-106 (host-side Python sampling of job arrivals
+and per-task durations). Everything here is shape-static and traced into the
+environment's jitted step/reset."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import EnvParams
+from .bank import WAVE_FIRST, WAVE_FRESH, WAVE_REST, WorkloadBank
+
+
+def sample_job_sequence(
+    params: EnvParams, bank: WorkloadBank, rng: jax.Array,
+    time_limit: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sample up to `max_jobs` Poisson arrivals (reference tpch.py:54-73):
+    the first job arrives at t=0, subsequent inter-arrival gaps are
+    Exponential(1/rate); arrivals stop at the time limit or the cap.
+
+    Returns (arrival_times[J] with inf padding, templates[J], arrived_cap
+    num_jobs scalar, mask[J])."""
+    j_cap = params.max_jobs
+    k_gap, k_tpl = jax.random.split(rng)
+    mean_gap = 1.0 / params.job_arrival_rate
+    gaps = jax.random.exponential(k_gap, (j_cap,)) * mean_gap
+    arrivals = jnp.concatenate(
+        [jnp.zeros(1), jnp.cumsum(gaps)[: j_cap - 1]]
+    ).astype(jnp.float32)
+    mask = arrivals < time_limit
+    mask = mask.at[0].set(True)  # first job must arrive at t=0
+    # arrivals must be a prefix: a job only exists if all earlier ones do
+    mask = jnp.cumprod(mask.astype(jnp.int32)).astype(bool)
+    templates = jax.random.randint(
+        k_tpl, (j_cap,), 0, bank.num_templates, dtype=jnp.int32
+    )
+    num_jobs = mask.sum().astype(jnp.int32)
+    arrivals = jnp.where(mask, arrivals, jnp.inf)
+    return arrivals, templates, num_jobs, mask
+
+
+def sample_executor_key(
+    bank: WorkloadBank, rng: jax.Array, template: jnp.ndarray,
+    stage: jnp.ndarray, num_local: jnp.ndarray
+) -> jnp.ndarray:
+    """Map the executor count to a trace executor-level index, randomly
+    interpolating between the two bracketing levels and falling back to the
+    max level present for this stage (reference tpch.py:216-235)."""
+    left_v = bank.itv_left_val[num_local]
+    right_v = bank.itv_right_val[num_local]
+    left_i = bank.itv_left_idx[num_local]
+    right_i = bank.itv_right_idx[num_local]
+    u = jax.random.uniform(rng)
+    rand_pt = 1 + (u * (right_v - left_v)).astype(jnp.int32)
+    use_left = (left_v == right_v) | (rand_pt <= num_local - left_v)
+    key_idx = jnp.where(use_left, left_i, right_i)
+    key_val = jnp.where(use_left, left_v, right_v)
+    # the reference's interval table leaves index num_executors zeroed when
+    # num_executors > 100 (tpch.py:258-260 excludes it); a 0 "level" is not
+    # a first_wave key there, so it falls through to the max present level
+    present = bank.level_present[template, stage, key_idx] & (key_val > 0)
+    return jnp.where(present, key_idx, bank.max_present[template, stage])
+
+
+def sample_task_duration(
+    params: EnvParams, bank: WorkloadBank, rng: jax.Array,
+    template: jnp.ndarray, stage: jnp.ndarray, num_local: jnp.ndarray,
+    task_valid: jnp.ndarray, same_stage: jnp.ndarray
+) -> jnp.ndarray:
+    """Sample one task duration, reproducing the reference's wave logic and
+    try/except fallback chains (tpch.py:75-106):
+
+    - executor idle (`task_valid` False — it was just sitting or moving):
+      fresh_durations, else first_wave + warmup_delay;
+    - executor continuing the same stage: rest_wave, else first_wave, else
+      fresh_durations;
+    - executor new to this stage: first_wave, else fresh_durations.
+
+    A final fallback to the stage's rough mean duration replaces the
+    reference's uncaught exception when a bucket is entirely empty."""
+    k_key, k_pick = jax.random.split(rng)
+    li = sample_executor_key(bank, k_key, template, stage, num_local)
+
+    cnt = bank.cnt[template, stage, :, li]  # i32[3]
+    has = cnt > 0
+    fresh_i, first_i, rest_i = WAVE_FRESH, WAVE_FIRST, WAVE_REST
+
+    # wave choice + warmup flag per the chains above
+    idle_wave = jnp.where(has[fresh_i], fresh_i, first_i)
+    idle_warm = ~has[fresh_i]
+    same_wave = jnp.where(
+        has[rest_i], rest_i, jnp.where(has[first_i], first_i, fresh_i)
+    )
+    diff_wave = jnp.where(has[first_i], first_i, fresh_i)
+
+    wave = jnp.where(
+        ~task_valid, idle_wave, jnp.where(same_stage, same_wave, diff_wave)
+    )
+    warm = jnp.where(~task_valid, idle_warm, False)
+
+    n = jnp.maximum(cnt[wave], 1)
+    pick = jax.random.randint(k_pick, (), 0, n)
+    dur = bank.dur[template, stage, wave, li, pick]
+    dur = jnp.where(
+        cnt[wave] > 0, dur, bank.rough_duration[template, stage]
+    )
+    return dur + jnp.where(warm, params.warmup_delay, 0.0)

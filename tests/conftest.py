@@ -1,0 +1,11 @@
+import os
+
+# run the test suite on a virtual 8-device CPU mesh so multi-chip sharding
+# is exercised without TPU hardware
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

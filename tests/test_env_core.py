@@ -1,0 +1,154 @@
+"""Unit tests for the vectorized simulator core: invariants, vmap batching,
+and workload bank integrity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparksched_tpu.config import EnvParams
+from sparksched_tpu.env.core import reset, step
+from sparksched_tpu.env.observe import observe
+from sparksched_tpu.workload import make_workload_bank
+from sparksched_tpu.workload.bank import topological_levels
+
+
+@pytest.fixture(scope="module")
+def small_setup():
+    params = EnvParams(num_executors=10, max_jobs=6, max_stages=20)
+    bank = make_workload_bank(10)
+    return params, bank
+
+
+def greedy_episode(params, bank, seed, max_steps=4000):
+    """Run one episode with a host-side greedy policy; returns the final
+    state and step count."""
+    state = reset(params, bank, jax.random.PRNGKey(seed))
+    steps = 0
+    while not bool(state.terminated | state.truncated):
+        obs = observe(params, state)
+        flat = np.asarray(obs.schedulable).reshape(-1)
+        idx = int(flat.argmax()) if flat.any() else -1
+        state, _, _, _ = step(
+            params, bank, state, jnp.int32(idx),
+            jnp.int32(int(obs.num_committable)),
+        )
+        steps += 1
+        assert steps < max_steps, "episode did not terminate"
+    return state, steps
+
+
+def test_episode_terminates_and_completes_jobs(small_setup):
+    params, bank = small_setup
+    state, steps = greedy_episode(params, bank, seed=0)
+    n = int(state.num_jobs)
+    assert bool(state.terminated)
+    completions = np.asarray(state.job_t_completed)[:n]
+    arrivals = np.asarray(state.job_arrival_time)[:n]
+    assert np.isfinite(completions).all()
+    assert (completions > arrivals).all()
+    # all tasks accounted for
+    done = np.asarray(state.stage_completed_tasks)
+    total = np.asarray(state.stage_num_tasks)
+    assert (done == total).all()
+
+
+def test_invariants_along_episode(small_setup):
+    params, bank = small_setup
+    state = reset(params, bank, jax.random.PRNGKey(1))
+    for t in range(300):
+        if bool(state.terminated):
+            break
+        obs = observe(params, state)
+        # executor conservation: every executor is in exactly one of
+        # common / attached / moving
+        at_common = np.asarray(state.exec_at_common)
+        attached = np.asarray(state.exec_job) >= 0
+        moving = np.asarray(state.exec_moving)
+        states = at_common.astype(int) + attached.astype(int) + moving.astype(int)
+        assert (states <= 1).all(), f"step {t}: overlapping exec states"
+        # commitment count bound (supply >= demand invariant)
+        assert int(np.asarray(state.cm_valid).sum()) <= params.num_executors
+        # committable never negative
+        assert int(obs.num_committable) >= 0
+        # schedulable stages are active and unsaturated
+        sched = np.asarray(state.schedulable)
+        if sched.any():
+            rem = np.asarray(state.stage_remaining)
+            assert (rem[sched] > 0).all()
+        flat = sched.reshape(-1)
+        idx = int(flat.argmax()) if flat.any() else -1
+        state, _, _, _ = step(
+            params, bank, state, jnp.int32(idx), jnp.int32(1)
+        )
+
+
+def test_vmap_batch_runs(small_setup):
+    params, bank = small_setup
+    batch = 8
+    rngs = jax.random.split(jax.random.PRNGKey(42), batch)
+    v_reset = jax.vmap(lambda r: reset(params, bank, r))
+    states = v_reset(rngs)
+    assert states.wall_time.shape == (batch,)
+
+    def greedy_action(obs):
+        flat = obs.schedulable.reshape(-1)
+        has = flat.any()
+        idx = jnp.where(has, jnp.argmax(flat), -1)
+        return idx.astype(jnp.int32), jnp.maximum(obs.num_committable, 1)
+
+    def one_step(state):
+        obs = observe(params, state)
+        idx, n = greedy_action(obs)
+        state, rew, term, trunc = step(params, bank, state, idx, n)
+        return state, rew
+
+    v_step = jax.jit(jax.vmap(one_step))
+    for _ in range(50):
+        states, rews = v_step(states)
+    assert np.isfinite(np.asarray(rews)).all()
+    assert (np.asarray(states.wall_time) > 0).any()
+
+
+def test_reward_is_negative_jobtime(small_setup):
+    params, bank = small_setup
+    state, _ = greedy_episode(params, bank, seed=3)
+    # total reward equals negative integral of #active jobs over time ==
+    # -sum of job durations (every job arrives and completes in-episode)
+    n = int(state.num_jobs)
+    durations = (
+        np.asarray(state.job_t_completed)[:n]
+        - np.asarray(state.job_arrival_time)[:n]
+    )
+    state2 = reset(params, bank, jax.random.PRNGKey(3))
+    total_rew = 0.0
+    while not bool(state2.terminated):
+        obs = observe(params, state2)
+        flat = np.asarray(obs.schedulable).reshape(-1)
+        idx = int(flat.argmax()) if flat.any() else -1
+        state2, r, _, _ = step(
+            params, bank, state2, jnp.int32(idx),
+            jnp.int32(int(obs.num_committable)),
+        )
+        total_rew += float(r)
+    np.testing.assert_allclose(-total_rew, durations.sum(), rtol=1e-4)
+
+
+def test_topological_levels():
+    adj = np.zeros((4, 4), dtype=bool)
+    adj[0, 1] = adj[0, 2] = adj[1, 3] = adj[2, 3] = True
+    lv = topological_levels(adj, 4)
+    assert lv.tolist() == [0, 1, 1, 2]
+
+
+def test_bank_shapes(small_setup):
+    _, bank = small_setup
+    assert bank.num_templates == 154  # 22 queries x 7 sizes
+    assert (np.asarray(bank.num_stages) >= 2).all()
+    assert (np.asarray(bank.num_stages) <= bank.max_stages).all()
+    # every existing stage has all-positive durations and a present level
+    ns = np.asarray(bank.num_stages)
+    cnt = np.asarray(bank.cnt)
+    for t in [0, 50, 153]:
+        for s in range(ns[t]):
+            assert cnt[t, s].sum() > 0
