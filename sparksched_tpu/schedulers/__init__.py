@@ -1,0 +1,33 @@
+"""Scheduler plugin layer.
+
+Mirrors the reference `schedulers/` package (scheduler.py:10-55,
+__init__.py:17-21): a `Scheduler` interface, string-keyed factory, two
+heuristics and the trainable Decima policy — but every policy here is a pure
+jittable function over the padded `Observation`, so it can run inside
+`jax.vmap`/`lax.scan` rollouts entirely on device.
+"""
+
+from .base import Scheduler, TrainableScheduler  # noqa: F401
+from .heuristics import (  # noqa: F401
+    RandomScheduler,
+    RoundRobinScheduler,
+    find_stage_per_job,
+    random_policy,
+    round_robin_policy,
+)
+from .decima import DecimaScheduler  # noqa: F401
+
+_REGISTRY = {
+    "RoundRobinScheduler": RoundRobinScheduler,
+    "RandomScheduler": RandomScheduler,
+    "DecimaScheduler": DecimaScheduler,
+}
+
+
+def make_scheduler(agent_cfg: dict) -> Scheduler:
+    """String-keyed factory (reference schedulers/__init__.py:17-21)."""
+    cls_name = agent_cfg["agent_cls"]
+    if cls_name not in _REGISTRY:
+        raise ValueError(f"'{cls_name}' is not a valid scheduler.")
+    kwargs = {k: v for k, v in agent_cfg.items() if k != "agent_cls"}
+    return _REGISTRY[cls_name](**kwargs)

@@ -1,0 +1,51 @@
+"""Scheduler interfaces (reference schedulers/scheduler.py:10-55).
+
+Two calling conventions coexist:
+
+- `schedule(obs) -> (action, info)`: host-side, one decision at a time —
+  the reference's contract, kept for drop-in compatibility and debugging.
+- `policy(rng, obs, ...) -> (stage_idx, num_exec, info)`: pure jittable
+  function over the padded `Observation`, the TPU-native path used inside
+  vmapped/scanned rollouts. `stage_idx` is a flat padded node index
+  (job * max_stages + stage, or -1 for "no selection").
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+import jax
+
+
+class Scheduler(abc.ABC):
+    """Interface for all schedulers (reference scheduler.py:10-18)."""
+
+    name: str
+
+    @abc.abstractmethod
+    def schedule(self, obs: Any) -> tuple[dict[str, Any], dict[str, Any]]:
+        """One decision from a single padded Observation. Returns
+        ({"stage_idx": flat padded index | -1, "num_exec": int}, info)."""
+
+    @abc.abstractmethod
+    def policy(self, rng: jax.Array, obs: Any):
+        """Pure jittable single-decision function; vmap/scan-safe."""
+
+
+class TrainableScheduler(Scheduler):
+    """Interface for trainable schedulers (reference scheduler.py:21-55).
+
+    The torch `nn.Module` + owned-optimizer design becomes functional:
+    parameters are an explicit pytree, `evaluate_actions` is a pure function
+    of (params, rollout arrays), and the optimizer lives with the trainer
+    (optax), so `update_parameters` (reference :37-54) has no analogue here —
+    gradient clipping and the update are part of the trainer's jitted step.
+    """
+
+    params: Any  # flax parameter pytree
+
+    @abc.abstractmethod
+    def evaluate_actions(self, params: Any, obsns: Any, actions: Any):
+        """Log-probs and entropies of `actions` under `params`, batched over
+        the rollout. Pure; differentiable wrt `params`."""

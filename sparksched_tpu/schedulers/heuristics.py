@@ -1,0 +1,137 @@
+"""Heuristic schedulers as branch-free jitted policies.
+
+Semantics mirror the reference heuristics exactly
+(schedulers/heuristics/round_robin.py:14-49, random_scheduler.py:16-32,
+utils.py:17-37) but operate on the padded Observation: the Python loops over
+jobs/stages become masked argmax selections, so thousands of scheduling
+decisions run per TPU core under `jax.vmap`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..env.observe import Observation
+from .base import Scheduler
+
+_i32 = jnp.int32
+
+
+def find_stage_per_job(obs: Observation):
+    """Per-job stage selection, frontier-preferred (reference
+    heuristics/utils.py:17-37): for each job, the first schedulable frontier
+    stage, else the first schedulable stage. Returns (stage[J] with -1 for
+    none, has[J])."""
+    sched = obs.schedulable
+    front = sched & obs.frontier
+    s_cap = sched.shape[1]
+    first_sched = jnp.argmax(sched, axis=1)
+    first_front = jnp.argmax(front, axis=1)
+    has_front = front.any(axis=1)
+    has = sched.any(axis=1)
+    sel = jnp.where(has_front, first_front, first_sched)
+    return jnp.where(has, sel, -1).astype(_i32), has
+
+
+@partial(jax.jit, static_argnames=("num_executors", "dynamic_partition"))
+def round_robin_policy(
+    obs: Observation, num_executors: int, dynamic_partition: bool = True
+):
+    """Fair (dynamic per-job executor cap) or FIFO scheduling (reference
+    round_robin.py:14-49). Returns (flat stage_idx | -1, num_exec)."""
+    s_cap = obs.schedulable.shape[1]
+    j_cap = obs.schedulable.shape[0]
+    n_active = obs.job_mask.sum()
+    if dynamic_partition:
+        cap = jnp.ceil(num_executors / jnp.maximum(1, n_active)).astype(_i32)
+    else:
+        cap = _i32(num_executors)
+
+    sel, has = find_stage_per_job(obs)
+    committable = obs.num_committable
+
+    # branch 1: a stage in the job that is releasing executors (:22-30)
+    src = obs.source_job
+    src_ok = (src >= 0) & has[jnp.maximum(src, 0)]
+
+    # branch 2: jobs in arrival order == job-id order (job ids are assigned
+    # in arrival order both here and in the reference)
+    j_idx = jnp.arange(j_cap)
+    supplies = obs.exec_supplies
+    want = obs.job_mask & has & (supplies < cap) & (j_idx != src)
+    any_want = want.any()
+    j_pick = jnp.argmax(want)
+
+    stage_src = src * s_cap + sel[jnp.maximum(src, 0)]
+    stage_loop = j_pick.astype(_i32) * s_cap + sel[j_pick]
+    n_loop = jnp.minimum(committable, cap - supplies[j_pick])
+
+    stage_idx = jnp.where(
+        src_ok, stage_src, jnp.where(any_want, stage_loop, -1)
+    ).astype(_i32)
+    num_exec = jnp.where(src_ok | ~any_want, committable, n_loop).astype(_i32)
+    return stage_idx, num_exec
+
+
+@jax.jit
+def random_policy(rng: jax.Array, obs: Observation):
+    """Uniform-random job with a schedulable stage, frontier-preferred stage
+    within it, uniform executor count in [1, committable] (reference
+    random_scheduler.py:16-32)."""
+    s_cap = obs.schedulable.shape[1]
+    sel, has = find_stage_per_job(obs)
+    k_job, k_n = jax.random.split(rng)
+    n_has = has.sum()
+    p = jnp.where(has, 1.0, 0.0) / jnp.maximum(1, n_has)
+    j = jax.random.choice(k_job, has.shape[0], p=p)
+    stage_idx = jnp.where(n_has > 0, j.astype(_i32) * s_cap + sel[j], -1)
+    num_exec = jax.random.randint(
+        k_n, (), 1, jnp.maximum(obs.num_committable, 1) + 1, dtype=_i32
+    )
+    return stage_idx.astype(_i32), num_exec
+
+
+class RoundRobinScheduler(Scheduler):
+    """Fair/FIFO heuristic (reference round_robin.py:7-49)."""
+
+    def __init__(self, num_executors: int, dynamic_partition: bool = True,
+                 **_: Any) -> None:
+        self.name = "Fair" if dynamic_partition else "FIFO"
+        self.num_executors = int(num_executors)
+        self.dynamic_partition = bool(dynamic_partition)
+
+    def policy(self, rng: jax.Array, obs: Observation):
+        stage_idx, num_exec = round_robin_policy(
+            obs, self.num_executors, self.dynamic_partition
+        )
+        return stage_idx, num_exec, {}
+
+    def schedule(self, obs: Observation):
+        stage_idx, num_exec = round_robin_policy(
+            obs, self.num_executors, self.dynamic_partition
+        )
+        return {"stage_idx": int(stage_idx), "num_exec": int(num_exec)}, {}
+
+
+class RandomScheduler(Scheduler):
+    """Uniform-random heuristic (reference random_scheduler.py:7-32)."""
+
+    def __init__(self, seed: int = 42, **_: Any) -> None:
+        self.name = "Random"
+        self.set_seed(seed)
+
+    def set_seed(self, seed: int) -> None:
+        self._rng = jax.random.PRNGKey(seed)
+
+    def policy(self, rng: jax.Array, obs: Observation):
+        stage_idx, num_exec = random_policy(rng, obs)
+        return stage_idx, num_exec, {}
+
+    def schedule(self, obs: Observation):
+        self._rng, sub = jax.random.split(self._rng)
+        stage_idx, num_exec = random_policy(sub, obs)
+        return {"stage_idx": int(stage_idx), "num_exec": int(num_exec)}, {}

@@ -1,11 +1,19 @@
 import os
 
-# run the test suite on a virtual 8-device CPU mesh so multi-chip sharding
-# is exercised without TPU hardware
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Run the test suite on a virtual 8-device CPU mesh so multi-chip sharding
+# is exercised without TPU hardware. The interpreter in this image preloads
+# jax with JAX_PLATFORMS=axon (real TPU), so env vars alone are too late —
+# jax.config still works as long as no computation has initialized the
+# backend yet.
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()

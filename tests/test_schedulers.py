@@ -1,0 +1,445 @@
+"""Scheduler tests: golden parity of the fair heuristic against the
+reference implementation, an independent numpy replica of the Decima
+forward pass, torch-checkpoint conversion, and sample/evaluate
+consistency."""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from .reference_fixtures import (
+    make_reference_env,
+    make_tpu_env_state,
+    reference_available,
+    spec_multi_job,
+)
+
+
+# ---------------------------------------------------------------------------
+# reference heuristics import (stubbing out the PyG stack, which is not
+# installed here and is only needed by the reference's Decima model)
+# ---------------------------------------------------------------------------
+
+
+def _stub_module(name: str, **attrs):
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    sys.modules.setdefault(name, mod)
+    return sys.modules[name]
+
+
+def import_reference_round_robin():
+    sys.path.insert(0, "/root/reference")
+    pyg = _stub_module("torch_geometric")
+    data = _stub_module("torch_geometric.data", Batch=object)
+    utils = _stub_module(
+        "torch_geometric.utils",
+        softmax=None,
+        mask_to_index=None,
+        index_to_mask=None,
+    )
+    pyg.data = data
+    pyg.utils = utils
+    _stub_module("torch_sparse", SparseTensor=object, matmul=None)
+    _stub_module("torch_scatter", segment_csr=None)
+    from schedulers import RoundRobinScheduler  # noqa: E501
+
+    return RoundRobinScheduler
+
+
+# ---------------------------------------------------------------------------
+# fair-heuristic golden parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not reference_available(), reason="no reference mounted")
+@pytest.mark.parametrize("dynamic_partition", [True, False])
+def test_fair_parity_vs_reference(dynamic_partition):
+    """Reference env + reference RoundRobin vs TPU env + jitted round_robin
+    policy: identical wall-time trajectories and job completion times."""
+    import jax.numpy as jnp
+
+    from sparksched_tpu.env import core
+    from sparksched_tpu.env.observe import observe
+    from sparksched_tpu.schedulers import round_robin_policy
+
+    RefRR = import_reference_round_robin()
+    spec = spec_multi_job(num_jobs=4, seed=11)
+    num_exec = 5
+
+    # --- reference side ---
+    ref_env = make_reference_env(spec, num_exec)
+    ref_sched = RefRR(num_exec, dynamic_partition=dynamic_partition)
+    obs, _ = ref_env.reset(seed=0)
+    ref_walls = []
+    done = False
+    while not done:
+        action, _ = ref_sched.schedule(obs)
+        obs, _, term, trunc, info = ref_env.step(action)
+        ref_walls.append(info["wall_time"])
+        done = term or trunc
+    ref_completions = sorted(
+        float(j.t_completed - j.t_arrival) for j in ref_env.jobs.values()
+    )
+
+    # --- TPU side ---
+    params, bank, state = make_tpu_env_state(spec, num_exec)
+    tpu_walls = []
+    steps = 0
+    while not bool(state.terminated) and steps < 5000:
+        ob = observe(params, state)
+        stage_idx, n = round_robin_policy(ob, num_exec, dynamic_partition)
+        state, _, term, trunc = core.step(
+            params, bank, state, stage_idx, n
+        )
+        tpu_walls.append(float(state.wall_time))
+        steps += 1
+    tpu_completions = sorted(
+        float(state.job_t_completed[j] - state.job_arrival_time[j])
+        for j in range(params.max_jobs)
+    )
+
+    assert len(ref_walls) == len(tpu_walls)
+    np.testing.assert_allclose(ref_walls, tpu_walls, rtol=1e-6)
+    np.testing.assert_allclose(ref_completions, tpu_completions, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Decima forward: independent numpy replica on the compact graph
+# ---------------------------------------------------------------------------
+
+
+def _np_mlp(params, name, x, act):
+    p = params["params"][name]
+    n_layers = len(p)
+    for i in range(n_layers):
+        d = p[f"dense_{i}"]
+        x = x @ np.asarray(d["kernel"]) + np.asarray(d["bias"])
+        if i < n_layers - 1:
+            x = act(x)
+    return x
+
+
+def _np_decima_forward(params, x, edges, num_nodes_per_dag, num_executors,
+                       embed_dim):
+    """Numpy replica following the reference control flow
+    (scheduler.py:191-234,244-276,279-385): explicit edge lists, levels from
+    networkx topological generations, compact arrays — no padding."""
+    import networkx as nx
+
+    def leaky(v):
+        return np.where(v >= 0, v, 0.2 * v)
+
+    def tanh(v):
+        return np.tanh(v)
+
+    n_nodes = x.shape[0]
+    h_init = _np_mlp(params, "mlp_prep", x, leaky)
+
+    G = nx.DiGraph()
+    G.add_nodes_from(range(n_nodes))
+    G.add_edges_from(edges)
+    levels = list(nx.topological_generations(G))
+
+    h = np.zeros_like(h_init)
+    has_child = np.zeros(n_nodes, bool)
+    for p_, c in edges:
+        has_child[p_] = True
+    h[~has_child] = _np_mlp(params, "mlp_update", h_init[~has_child], leaky)
+    if len(edges) == 0:
+        h = h_init.copy()
+    else:
+        for level in reversed(levels[:-1]):
+            for p_ in level:
+                children = [c for (pp, c) in edges if pp == p_]
+                if not children:
+                    continue
+                agg = sum(
+                    _np_mlp(params, "mlp_msg", h[c], leaky)
+                    for c in children
+                )
+                h[p_] = h_init[p_] + _np_mlp(
+                    params, "mlp_update", agg, leaky
+                )
+
+    # dag / global embeddings
+    ptr = np.concatenate([[0], np.cumsum(num_nodes_per_dag)])
+    z = _np_mlp(
+        params, "mlp_dag", np.concatenate([x, h], axis=1), leaky
+    )
+    h_dag = np.stack(
+        [z[ptr[i]: ptr[i + 1]].sum(0) for i in range(len(ptr) - 1)]
+    )
+    h_glob = _np_mlp(params, "mlp_glob", h_dag, leaky).sum(0)
+
+    # stage scores
+    dag_of = np.repeat(np.arange(len(num_nodes_per_dag)), num_nodes_per_dag)
+    stage_in = np.concatenate(
+        [
+            x,
+            h,
+            h_dag[dag_of],
+            np.tile(h_glob, (n_nodes, 1)),
+        ],
+        axis=1,
+    )
+    stage_scores = _np_mlp(params, "mlp_stage", stage_in, tanh)[:, 0]
+
+    # exec scores per dag
+    exec_scores = []
+    for j in range(len(num_nodes_per_dag)):
+        x_dag = x[ptr[j], :3]
+        rows = []
+        for k in range(num_executors):
+            rows.append(
+                np.concatenate(
+                    [x_dag, h_dag[j], h_glob, [k / num_executors]]
+                )
+            )
+        exec_scores.append(
+            _np_mlp(params, "mlp_exec", np.stack(rows), tanh)[:, 0]
+        )
+    return stage_scores, np.stack(exec_scores)
+
+
+def test_decima_forward_matches_numpy_replica():
+    """Padded flax forward == compact numpy replica on a random two-job
+    graph (one diamond DAG, one chain), including masking of inactive
+    slots."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.schedulers.decima import (
+        DecimaFeatures,
+        DecimaNet,
+        NUM_NODE_FEATURES,
+    )
+
+    num_exec, d = 7, 8
+    net = DecimaNet(
+        num_executors=num_exec,
+        embed_dim=d,
+        gnn_hid=(12, 8),
+        policy_hid=(16, 16),
+        gnn_act_kwargs=(("negative_slope", 0.2),),
+    )
+
+    j_cap, s_cap = 3, 5  # one padding job slot, padding stage slots
+    rng = np.random.default_rng(3)
+    # job 0: diamond on stages {0,1,2,3}; job 1: chain 0->1->2
+    adj = np.zeros((j_cap, s_cap, s_cap), bool)
+    adj[0, 0, 1] = adj[0, 0, 2] = adj[0, 1, 3] = adj[0, 2, 3] = True
+    adj[1, 0, 1] = adj[1, 1, 2] = True
+    node_mask = np.zeros((j_cap, s_cap), bool)
+    node_mask[0, :4] = True
+    node_mask[1, :3] = True
+    job_mask = np.array([True, True, False])
+    level = np.full((j_cap, s_cap), s_cap, np.int32)
+    level[0, :4] = [0, 1, 1, 2]
+    level[1, :3] = [0, 1, 2]
+    x = rng.normal(size=(j_cap, s_cap, NUM_NODE_FEATURES)).astype(np.float32)
+    x[~node_mask] = 0.0
+    # features 0..2 are per-job constants in real observations
+    for j in range(j_cap):
+        x[j, :, :3] = x[j, 0, :3]
+    x[~node_mask] = 0.0
+
+    feats = DecimaFeatures(
+        x=jnp.asarray(x),
+        node_mask=jnp.asarray(node_mask),
+        job_mask=jnp.asarray(job_mask),
+        stage_mask=jnp.asarray(node_mask),
+        exec_mask=jnp.asarray(
+            np.tile(job_mask[:, None], (1, num_exec))
+        ),
+        adj=jnp.asarray(adj),
+        node_level=jnp.asarray(level),
+    )
+    params = net.init(jax.random.PRNGKey(0), feats)
+    stage_scores, exec_scores = net.apply(params, feats)
+
+    # compact replica
+    xs = np.concatenate([x[0, :4], x[1, :3]])
+    edges = [(0, 1), (0, 2), (1, 3), (2, 3), (4, 5), (5, 6)]
+    ref_stage, ref_exec = _np_decima_forward(
+        jax.tree_util.tree_map(np.asarray, params),
+        xs, edges, [4, 3], num_exec, d,
+    )
+
+    got_stage = np.concatenate(
+        [np.asarray(stage_scores)[0, :4], np.asarray(stage_scores)[1, :3]]
+    )
+    np.testing.assert_allclose(got_stage, ref_stage, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(exec_scores)[:2], ref_exec, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_decima_no_edges_fast_path():
+    """With zero active edges anywhere, h_node must equal mlp_prep(x)
+    (reference scheduler.py:236-241), not mlp_update(mlp_prep(x))."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.schedulers.decima import (
+        DecimaFeatures,
+        DecimaNet,
+        NUM_NODE_FEATURES,
+    )
+
+    num_exec = 4
+    net = DecimaNet(num_executors=num_exec, embed_dim=6, gnn_hid=(8,),
+                    policy_hid=(8,))
+    j_cap, s_cap = 2, 3
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(j_cap, s_cap, NUM_NODE_FEATURES)).astype(np.float32)
+    node_mask = np.ones((j_cap, s_cap), bool)
+    feats = DecimaFeatures(
+        x=jnp.asarray(x),
+        node_mask=jnp.asarray(node_mask),
+        job_mask=jnp.ones(j_cap, bool),
+        stage_mask=jnp.asarray(node_mask),
+        exec_mask=jnp.ones((j_cap, num_exec), bool),
+        adj=jnp.zeros((j_cap, s_cap, s_cap), bool),
+        node_level=jnp.zeros((j_cap, s_cap), jnp.int32),
+    )
+    params = net.init(jax.random.PRNGKey(1), feats)
+    stage_scores, _ = net.apply(params, feats)
+
+    def leaky(v):
+        return np.where(v >= 0, v, 0.01 * v)
+
+    np_params = jax.tree_util.tree_map(np.asarray, params)
+    h = _np_mlp(np_params, "mlp_prep", x.reshape(-1, NUM_NODE_FEATURES),
+                leaky)
+    z = _np_mlp(
+        np_params, "mlp_dag",
+        np.concatenate([x.reshape(-1, NUM_NODE_FEATURES), h], axis=1),
+        leaky,
+    )
+    h_dag = z.reshape(j_cap, s_cap, -1).sum(1)
+    h_glob = _np_mlp(np_params, "mlp_glob", h_dag, leaky).sum(0)
+    stage_in = np.concatenate(
+        [
+            x.reshape(-1, NUM_NODE_FEATURES),
+            h,
+            np.repeat(h_dag, s_cap, axis=0),
+            np.tile(h_glob, (j_cap * s_cap, 1)),
+        ],
+        axis=1,
+    )
+    ref = _np_mlp(np_params, "mlp_stage", stage_in, np.tanh)[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(stage_scores).reshape(-1), ref, rtol=1e-4, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# torch checkpoint conversion
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not reference_available(), reason="no reference mounted")
+def test_pretrained_checkpoint_conversion():
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.schedulers import DecimaScheduler
+
+    sched = DecimaScheduler(
+        num_executors=50,
+        embed_dim=16,
+        gnn_mlp_kwargs={
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+        state_dict_path="/root/reference/models/decima/model.pt",
+    )
+
+    import torch
+
+    sd = torch.load(
+        "/root/reference/models/decima/model.pt",
+        map_location="cpu",
+        weights_only=True,
+    )
+    flat = sched.params["params"]
+    # every torch tensor landed (42 tensors over 7 MLPs), transposed
+    n_mapped = sum(
+        2 * len(v) for v in flat.values()
+    )
+    assert n_mapped == len(sd) == 42
+    w = np.asarray(flat["mlp_prep"]["dense_0"]["kernel"])
+    np.testing.assert_allclose(
+        w, np.asarray(sd["encoder.node_encoder.mlp_prep.0.weight"]).T
+    )
+    b = np.asarray(flat["mlp_exec"]["dense_2"]["bias"])
+    np.testing.assert_allclose(
+        b, np.asarray(sd["exec_policy_network.mlp_score.4.bias"])
+    )
+
+
+# ---------------------------------------------------------------------------
+# sample / evaluate consistency
+# ---------------------------------------------------------------------------
+
+
+def test_sample_evaluate_consistency():
+    """The lgprob returned at sampling time must equal the lgprob
+    recomputed by evaluate_actions for the same action, and sampled actions
+    must always be schedulable."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.schedulers.decima import (
+        DecimaAction,
+        build_features,
+        evaluate_actions,
+        sample_action,
+    )
+    from sparksched_tpu.schedulers import DecimaScheduler
+    from sparksched_tpu.env import core
+    from sparksched_tpu.env.observe import observe
+    from .reference_fixtures import make_tpu_env_state
+
+    spec = spec_multi_job(num_jobs=3, seed=5)
+    num_exec = 4
+    params, bank, state = make_tpu_env_state(spec, num_exec)
+    sched = DecimaScheduler(num_executors=num_exec, embed_dim=8,
+                            gnn_mlp_kwargs={"hid_dims": [8]},
+                            policy_mlp_kwargs={"hid_dims": [8]})
+
+    rng = jax.random.PRNGKey(0)
+    apply = jax.jit(sched.net.apply)
+    n_checked = 0
+    for _ in range(30):
+        if bool(state.terminated):
+            break
+        obs = observe(params, state)
+        f = sched.features(obs)
+        stage_scores, exec_scores = apply(sched.params, f)
+        rng, sub = jax.random.split(rng)
+        action, lgprob = sample_action(sub, stage_scores, exec_scores, f)
+        if int(action.stage_idx) >= 0:
+            j, s = divmod(int(action.stage_idx), params.max_stages)
+            assert bool(obs.schedulable[j, s])
+            lgp2, ent = evaluate_actions(
+                stage_scores, exec_scores, f, action, num_exec
+            )
+            np.testing.assert_allclose(
+                float(lgprob), float(lgp2), rtol=1e-5
+            )
+            assert float(ent) >= 0.0
+            n_checked += 1
+        state, _, _, _ = core.step(
+            params, bank, state, action.stage_idx,
+            action.num_exec + 1,
+        )
+    assert n_checked >= 5
