@@ -638,7 +638,22 @@ def _compute_jobtime(
 @partial(jax.jit, static_argnums=0)
 def reset(params: EnvParams, bank: WorkloadBank, rng: jax.Array) -> EnvState:
     """Sample a fresh episode (reference :127-186 + StochasticTimeLimit)."""
-    k_limit, k_seq, k_state = jax.random.split(rng, 3)
+    return reset_pair(params, bank, rng, jax.random.fold_in(rng, 1))
+
+
+@partial(jax.jit, static_argnums=0)
+def reset_pair(
+    params: EnvParams, bank: WorkloadBank, seq_rng: jax.Array,
+    lane_rng: jax.Array
+) -> EnvState:
+    """Reset with separate keys for the job sequence / time limit
+    (`seq_rng`) and the per-lane stochastic stream (`lane_rng`). Lanes that
+    share `seq_rng` replay the same arrival sequence — the TPU analogue of
+    the reference's `num_sequences x num_rollouts` worker seed layout
+    (trainers/trainer.py:268-271), which the critic-free baseline relies
+    on (trainers/utils/baselines.py:12-18)."""
+    k_limit, k_seq = jax.random.split(seq_rng)
+    k_state = lane_rng
 
     if params.mean_time_limit is None:
         time_limit = INF

@@ -1,0 +1,50 @@
+"""Multi-chip scale-out over a `jax.sharding.Mesh`.
+
+The reference's only parallelism is process-level fan-out of rollout
+workers glued with `mp.Pipe` (reference trainers/trainer.py:264-296).
+The TPU-native equivalent has two layers:
+
+- on-chip: `jax.vmap` already runs thousands of env lanes per core — that
+  alone replaces the reference's N worker processes;
+- across chips: the lane axis is sharded over a 1-D `dp` mesh axis with
+  `NamedSharding(P("dp"))`. Rollout collection is embarrassingly parallel
+  along lanes; the PPO update's global minibatch permutation, advantage
+  normalization and gradient reduction become XLA collectives (all-gather /
+  psum) over ICI — no NCCL, no parameter scatter, no pickling. Multi-host
+  works the same way: the mesh simply spans hosts and the same collectives
+  ride DCN.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D data-parallel mesh over the first `n_devices` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        assert len(devices) >= n_devices, (
+            f"need {n_devices} devices, have {len(devices)}"
+        )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (DP_AXIS,))
+
+
+def lane_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (env-lane) axis over the dp mesh axis."""
+    return NamedSharding(mesh, P(DP_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_lanes(tree, mesh: Mesh):
+    """Place a [B, ...] pytree with its lane axis sharded over the mesh."""
+    return jax.device_put(tree, lane_sharding(mesh))

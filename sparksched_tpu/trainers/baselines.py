@@ -1,0 +1,50 @@
+"""Critic-free baseline (reference trainers/utils/baselines.py:4-53).
+
+Rollout lanes are laid out [num_sequences, num_rollouts]; lanes within a
+group replay the same job arrival sequence. Each lane's returns curve is
+linearly interpolated onto the union of the group's wall-time points, the
+baseline is the cross-lane mean at each point, and each lane reads the
+baseline back at its own times — all as vmapped `jnp.interp`s instead of
+the reference's per-group Python/np.interp loops.
+
+Padded (invalid) steps are sent to far-future sentinel times with their
+return forward-filled from the last valid step, which reproduces
+np.interp's constant right-extension (`fp[-1]`) for lanes that ended
+before others."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_SENTINEL = 1e12
+
+
+def _lane_curves(ts, ys, valid):
+    """Per lane: sentinel times for padding, forward-filled returns."""
+    t_cap = ts.shape[-1]
+    n_valid = valid.sum(-1, keepdims=True)
+    last_idx = jnp.maximum(n_valid - 1, 0)
+    last_val = jnp.take_along_axis(ys, last_idx, axis=-1)
+    ys_f = jnp.where(valid, ys, last_val)
+    ts_f = jnp.where(
+        valid, ts, _SENTINEL + jnp.arange(t_cap, dtype=ts.dtype)
+    )
+    return ts_f, ys_f
+
+
+def group_baselines(
+    wall_times: jnp.ndarray,  # f32[G,R,T] obs times (not the final time)
+    returns: jnp.ndarray,  # f32[G,R,T]
+    valid: jnp.ndarray,  # bool[G,R,T]
+) -> jnp.ndarray:
+    """f32[G,R,T] baselines (reference Baseline._average:20-37)."""
+
+    def per_group(ts, ys, vm):
+        ts_f, ys_f = _lane_curves(ts, ys, vm)
+        union = jnp.sort(ts_f.reshape(-1))
+        y_hats = jax.vmap(lambda t, y: jnp.interp(union, t, y))(ts_f, ys_f)
+        mean = y_hats.mean(axis=0)
+        return jax.vmap(lambda t: jnp.interp(t, union, mean))(ts_f)
+
+    return jax.vmap(per_group)(wall_times, returns, valid)

@@ -1,0 +1,117 @@
+"""Return computation (reference trainers/utils/returns_calculator.py).
+
+Both reference modes, as pure jnp functions over padded [B,T] rollouts:
+
+- continuously discounted returns  R_k = r_k + e^{-beta*1e-3*dt_k} R_{k+1}
+  (reference :67-76) — a reverse `lax.scan`;
+- differential (average-reward) returns
+  R_k = -(jobtime_k - dt_k * avg_num_jobs) + R_{k+1} (reference :52-65),
+  with `avg_num_jobs` estimated from a moving window over the last
+  `buff_cap` steps (reference CircularArray :6-21), kept here as a
+  fixed-shape ring buffer that is part of the train state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+_i32 = jnp.int32
+
+
+def step_dts(wall_times: jnp.ndarray) -> jnp.ndarray:
+    """dt[k] = wall_times[k+1] - wall_times[k] (reference :46)."""
+    return wall_times[..., 1:] - wall_times[..., :-1]
+
+
+def discounted_returns(
+    rewards: jnp.ndarray, dts: jnp.ndarray, beta: float
+) -> jnp.ndarray:
+    """[B,T] continuously discounted returns (reference :67-76). Invalid
+    (padded) steps must carry r=0; dt=0 there keeps the chain intact."""
+
+    def body(R, x):
+        r, dt = x
+        R = r + jnp.exp(-beta * 1e-3 * dt) * R
+        return R, R
+
+    def one(rs, ds):
+        _, out = lax.scan(
+            body, jnp.float32(0.0), (rs, ds), reverse=True
+        )
+        return out
+
+    return jax.vmap(one)(rewards, dts)
+
+
+def differential_returns(
+    rewards: jnp.ndarray, dts: jnp.ndarray, avg_num_jobs: jnp.ndarray
+) -> jnp.ndarray:
+    """[B,T] differential returns (reference :52-65):
+    R_k = r_k + dt_k*avg_num_jobs + R_{k+1} (jobtime_k = -r_k)."""
+
+    def body(R, x):
+        r, dt = x
+        R = r + dt * avg_num_jobs + R
+        return R, R
+
+    def one(rs, ds):
+        _, out = lax.scan(body, jnp.float32(0.0), (rs, ds), reverse=True)
+        return out
+
+    return jax.vmap(one)(rewards, dts)
+
+
+class AvgNumJobsBuffer(struct.PyTreeNode):
+    """Ring buffer over the last `cap` (dt, reward) step records
+    (reference CircularArray :6-21). Unfilled slots are zero and contribute
+    nothing to either sum, exactly like the reference's zero-initialized
+    array."""
+
+    dt: jnp.ndarray  # f32[cap]
+    r: jnp.ndarray  # f32[cap]
+    ptr: jnp.ndarray  # i32 []
+
+    @classmethod
+    def create(cls, cap: int) -> "AvgNumJobsBuffer":
+        return cls(
+            dt=jnp.zeros(cap, jnp.float32),
+            r=jnp.zeros(cap, jnp.float32),
+            ptr=jnp.zeros((), _i32),
+        )
+
+    @property
+    def cap(self) -> int:
+        return self.dt.shape[0]
+
+    def extend(self, dts: jnp.ndarray, rewards: jnp.ndarray,
+               valid: jnp.ndarray) -> "AvgNumJobsBuffer":
+        """Append flat step records, dropping dt<=0 steps (reference
+        :81-84) and keeping only the newest `cap` if more arrive at once."""
+        cap = self.cap
+        dts, rewards, valid = (
+            dts.reshape(-1), rewards.reshape(-1), valid.reshape(-1)
+        )
+        keep = valid & (dts > 0)
+        m = dts.shape[0]
+        # compact kept entries to the front, preserving order
+        order = jnp.argsort(~keep, stable=True)
+        dt_c, r_c = dts[order], rewards[order]
+        n = keep.sum()
+        drop = jnp.maximum(n - cap, 0)  # ref keeps new_data[-cap:]
+        idx = jnp.arange(m)
+        take = (idx >= drop) & (idx < n)
+        pos = (self.ptr + idx - drop) % cap
+        pos = jnp.where(take, pos, cap)  # out-of-bounds -> dropped
+        return self.replace(
+            dt=self.dt.at[pos].set(dt_c, mode="drop"),
+            r=self.r.at[pos].set(r_c, mode="drop"),
+            ptr=(self.ptr + n - drop) % cap,
+        )
+
+    def avg_num_jobs(self) -> jnp.ndarray:
+        """-sum(rewards)/sum(dt) = total job-time per unit time
+        (reference :86-89)."""
+        return -self.r.sum() / jnp.maximum(self.dt.sum(), 1e-9)

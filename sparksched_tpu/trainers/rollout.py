@@ -1,0 +1,272 @@
+"""On-device rollout collection.
+
+The reference collects rollouts in `num_sequences x num_rollouts` separate
+OS processes, each running a Python env + torch policy episode loop and
+shipping pickled buffers over pipes (trainers/rollout_worker.py:49-206,
+trainer.py:264-296). Here a rollout is one `lax.scan` of
+policy∘env-step over T decision steps, vmapped over B environment lanes on
+one chip (and sharded over the device mesh for more) — parameter scatter
+and buffer gather disappear because learner and actors are one XLA program.
+
+Both reference modes exist:
+- sync (RolloutWorkerSync:132-157): one episode per lane per iteration;
+  steps after episode end are masked out (`valid=False`).
+- async (RolloutWorkerAsync:160-206): fixed sim-time budget per iteration;
+  lanes persist across iterations and auto-reset mid-scan, recording reset
+  steps.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from ..config import EnvParams
+from ..env import core
+from ..env.observe import Observation, observe
+from ..env.state import EnvState
+from ..workload.bank import WorkloadBank
+
+_i32 = jnp.int32
+
+
+class StoredObs(struct.PyTreeNode):
+    """Minimal per-step observation record from which `Observation` (and so
+    Decima features) can be rebuilt — the padded equivalent of the obs dicts
+    the reference keeps in RolloutBuffer.obsns (rollout_worker.py:27-39).
+    The [S,S] adjacency is *not* stored: it is reconstructed from the job's
+    template id, which shrinks the rollout memory footprint by ~10x."""
+
+    remaining: jnp.ndarray  # i32[J,S]
+    duration: jnp.ndarray  # f32[J,S]
+    schedulable: jnp.ndarray  # bool[J,S]
+    node_mask: jnp.ndarray  # bool[J,S]
+    job_mask: jnp.ndarray  # bool[J]
+    node_level: jnp.ndarray  # i32[J,S]
+    job_template: jnp.ndarray  # i32[J]
+    exec_supplies: jnp.ndarray  # i32[J]
+    num_committable: jnp.ndarray  # i32 []
+    source_job: jnp.ndarray  # i32 []
+
+
+def store_obs(obs: Observation, state: EnvState) -> StoredObs:
+    return StoredObs(
+        remaining=obs.nodes[..., 0].astype(_i32),
+        duration=obs.nodes[..., 1],
+        schedulable=obs.schedulable,
+        node_mask=obs.node_mask,
+        job_mask=obs.job_mask,
+        node_level=obs.node_level,
+        job_template=state.job_template,
+        exec_supplies=obs.exec_supplies,
+        num_committable=obs.num_committable,
+        source_job=obs.source_job,
+    )
+
+
+def stored_to_observation(bank: WorkloadBank, so: StoredObs) -> Observation:
+    """Rebuild the padded Observation a stored step was taken from."""
+    adj = (
+        bank.adj[so.job_template]
+        & so.node_mask[:, :, None]
+        & so.node_mask[:, None, :]
+    )
+    nodes = jnp.stack(
+        [
+            so.remaining.astype(jnp.float32),
+            so.duration,
+            so.schedulable.astype(jnp.float32),
+        ],
+        axis=-1,
+    )
+    return Observation(
+        nodes=nodes,
+        node_mask=so.node_mask,
+        job_mask=so.job_mask,
+        schedulable=so.schedulable,
+        frontier=jnp.zeros_like(so.schedulable),  # not needed by any model
+        adj=adj,
+        node_level=so.node_level,
+        exec_supplies=so.exec_supplies,
+        num_committable=so.num_committable,
+        source_job=so.source_job,
+        wall_time=jnp.float32(0.0),
+    )
+
+
+class Rollout(struct.PyTreeNode):
+    """One lane's fixed-length rollout (leading [T] axis on per-step
+    fields; vmapped collection adds a [B] axis in front)."""
+
+    obs: StoredObs  # [T, ...]
+    stage_idx: jnp.ndarray  # i32[T] flat padded node index (-1 = none)
+    job_idx: jnp.ndarray  # i32[T]
+    num_exec_k: jnp.ndarray  # i32[T] 0-based exec choice (Decima) or n-1
+    lgprob: jnp.ndarray  # f32[T]
+    reward: jnp.ndarray  # f32[T]
+    # wall_times[k] = time of obs k; wall_times[T] = final time
+    # (reference rollout_worker.py:154-156 appends the last wall time)
+    wall_times: jnp.ndarray  # f32[T+1]
+    valid: jnp.ndarray  # bool[T]; step actually happened
+    resets: jnp.ndarray  # bool[T]; async: env was reset after this step
+    final_state: EnvState
+
+    @property
+    def num_steps(self) -> jnp.ndarray:
+        return self.valid.sum()
+
+
+# policy_fn(rng, obs) -> (stage_idx, num_exec_1based, aux) where aux is a
+# dict containing at least {"lgprob", "job_idx", "num_exec_k"} for
+# trainable policies; heuristics may return {}.
+PolicyFn = Callable[[jax.Array, Observation], tuple]
+
+
+def _aux_fields(aux: dict, stage_idx: jnp.ndarray, num_exec: jnp.ndarray):
+    lgprob = aux.get("lgprob", jnp.float32(0.0))
+    job = aux.get("job_idx", jnp.where(stage_idx >= 0, stage_idx, 0))
+    k = aux.get("num_exec_k", num_exec - 1)
+    return lgprob, job, k
+
+
+@partial(jax.jit, static_argnums=(0, 2, 4))
+def collect_sync(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: PolicyFn,
+    rng: jax.Array,
+    num_steps: int,
+    state: EnvState,
+) -> Rollout:
+    """One episode (from the given freshly-reset state), padded to
+    `num_steps` decisions (reference RolloutWorkerSync.collect_rollout)."""
+
+    def body(carry, _):
+        st, k = carry
+        k, k_pol = jax.random.split(k)
+        obs = observe(params, st)
+        done = st.terminated | st.truncated
+        stage_idx, num_exec, aux = policy_fn(k_pol, obs)
+        nxt, reward, _, _ = core.step(params, bank, st, stage_idx, num_exec)
+        nxt = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done, a, b), st, nxt
+        )
+        lgprob, job, kk = _aux_fields(aux, stage_idx, num_exec)
+        rec = (
+            store_obs(obs, st),
+            jnp.where(done, -1, stage_idx),
+            job,
+            kk,
+            jnp.where(done, 0.0, lgprob),
+            jnp.where(done, 0.0, reward),
+            st.wall_time,
+            ~done,
+        )
+        return (nxt, k), rec
+
+    (final, _), (obs, stage_idx, job, kk, lgprob, reward, wt, valid) = (
+        lax.scan(body, (state, rng), None, length=num_steps)
+    )
+    wall_times = jnp.concatenate([wt, final.wall_time[None]])
+    return Rollout(
+        obs=obs,
+        stage_idx=stage_idx,
+        job_idx=job,
+        num_exec_k=kk,
+        lgprob=lgprob,
+        reward=reward,
+        wall_times=wall_times,
+        valid=valid,
+        resets=jnp.zeros_like(valid),
+        final_state=final,
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 2, 4))
+def collect_async(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: PolicyFn,
+    rng: jax.Array,
+    num_steps: int,
+    state: EnvState,
+    rollout_duration: jnp.ndarray | float = jnp.inf,
+) -> Rollout:
+    """Fixed sim-time budget with persistent envs and auto-reset (reference
+    RolloutWorkerAsync.collect_rollout:171-206). `wall_times` are *elapsed*
+    times within the iteration, continuing across resets. Steps after the
+    budget is exhausted are masked."""
+    rollout_duration = jnp.float32(rollout_duration)
+
+    def body(carry, _):
+        st, k, elapsed = carry
+        k, k_pol, k_reset = jax.random.split(k, 3)
+        obs = observe(params, st)
+        over = elapsed >= rollout_duration
+        stage_idx, num_exec, aux = policy_fn(k_pol, obs)
+        nxt, reward, term, trunc = core.step(
+            params, bank, st, stage_idx, num_exec
+        )
+        new_elapsed = elapsed + (nxt.wall_time - st.wall_time)
+        done = term | trunc
+
+        def do_reset(_):
+            return core.reset(params, bank, k_reset)
+
+        nxt2 = lax.cond(
+            done & ~over, do_reset, lambda _: nxt, operand=None
+        )
+        # budget exhausted: freeze the lane
+        nxt2 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(over, a, b), st, nxt2
+        )
+        new_elapsed = jnp.where(over, elapsed, new_elapsed)
+        lgprob, job, kk = _aux_fields(aux, stage_idx, num_exec)
+        rec = (
+            store_obs(obs, st),
+            jnp.where(over, -1, stage_idx),
+            job,
+            kk,
+            jnp.where(over, 0.0, lgprob),
+            jnp.where(over, 0.0, reward),
+            elapsed,
+            ~over,
+            done & ~over,
+        )
+        return (nxt2, k, new_elapsed), rec
+
+    (final, _, elapsed), (
+        obs, stage_idx, job, kk, lgprob, reward, wt, valid, resets
+    ) = lax.scan(
+        body, (state, rng, jnp.float32(0.0)), None, length=num_steps
+    )
+    wall_times = jnp.concatenate([wt, elapsed[None]])
+    return Rollout(
+        obs=obs,
+        stage_idx=stage_idx,
+        job_idx=job,
+        num_exec_k=kk,
+        lgprob=lgprob,
+        reward=reward,
+        wall_times=wall_times,
+        valid=valid,
+        resets=resets,
+        final_state=final,
+    )
+
+
+def vmap_collect(collect_fn, params, bank, policy_fn, rngs, num_steps,
+                 states, *args):
+    """Collect B rollouts in parallel: `rngs` [B,2] and `states` with a
+    leading [B] axis (the TPU replacement for the reference's B worker
+    processes)."""
+    return jax.vmap(
+        lambda r, s: collect_fn(
+            params, bank, policy_fn, r, num_steps, s, *args
+        )
+    )(rngs, states)

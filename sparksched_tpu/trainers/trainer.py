@@ -1,0 +1,380 @@
+"""Training orchestration (reference trainers/trainer.py:25-329).
+
+The reference Trainer spawns `num_sequences x num_rollouts` worker
+processes, scatters `state_dict`s over pipes, gathers pickled rollout
+buffers, and trains on them with torch. Here the whole iteration —
+vmapped env resets, scanned policy-in-the-loop rollouts, returns,
+baselines and the policy update — is jitted XLA code; the host loop only
+carries seeds, logging, best-model tracking and checkpoints.
+
+Config surface mirrors the reference YAML (config/decima_tpch.yaml):
+`trainer:` (num_iterations, num_sequences, num_rollouts, seed,
+artifacts_dir, checkpointing_freq, use_tensorboard, beta_discount |
+reward_buff_cap, rollout_duration -> async mode, opt_kwargs,
+max_grad_norm, + PPO keys), `agent:`, `env:`. One new required cap:
+`rollout_steps` — the static scan length (the reference's dynamic episode
+lengths become masked fixed-shape rollouts).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+import os.path as osp
+import pathlib
+import shutil
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization, struct
+
+from .. import metrics
+from ..config import EnvParams, env_params_from_cfg
+from ..env import core
+from ..schedulers import TrainableScheduler, make_scheduler
+from ..workload import make_workload_bank
+from .baselines import group_baselines
+from .returns import (
+    AvgNumJobsBuffer,
+    differential_returns,
+    discounted_returns,
+    step_dts,
+)
+from .rollout import Rollout, collect_async, collect_sync
+
+CfgType = dict[str, Any]
+
+
+class TrainState(struct.PyTreeNode):
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    buf: AvgNumJobsBuffer | None  # differential-returns window, or None
+    iteration: jnp.ndarray  # i32 []
+
+
+def make_optimizer(train_cfg: CfgType) -> optax.GradientTransformation:
+    """Adam + global-norm clipping (reference scheduler.py:37-54,
+    decima_tpch.yaml:60-63)."""
+    opt_cls = train_cfg.get("opt_cls", "Adam").lower()
+    kwargs = dict(train_cfg.get("opt_kwargs") or {})
+    lr = kwargs.pop("lr", 3e-4)
+    makers = {
+        "adam": optax.adam,
+        "adamw": optax.adamw,
+        "sgd": optax.sgd,
+        "rmsprop": optax.rmsprop,
+    }
+    if opt_cls not in makers:
+        raise ValueError(f"unsupported optimizer {opt_cls!r}")
+    tx = makers[opt_cls](lr, **kwargs)
+    max_grad_norm = train_cfg.get("max_grad_norm")
+    if max_grad_norm:
+        tx = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+    return tx
+
+
+class Trainer(abc.ABC):
+    """Base trainer; subclasses implement the jitted `_update`."""
+
+    def __init__(self, agent_cfg: CfgType, env_cfg: CfgType,
+                 train_cfg: CfgType, mesh=None) -> None:
+        self.seed: int = train_cfg.get("seed", 42)
+        self.num_iterations: int = train_cfg["num_iterations"]
+        self.num_sequences: int = train_cfg["num_sequences"]
+        self.num_rollouts: int = int(train_cfg["num_rollouts"])
+        self.num_envs = self.num_sequences * self.num_rollouts
+
+        self.artifacts_dir: str = train_cfg.get("artifacts_dir", "artifacts")
+        self.use_tensorboard: bool = train_cfg.get("use_tensorboard", False)
+        self.checkpointing_freq: int = train_cfg.get(
+            "checkpointing_freq", 50
+        )
+        self.rollout_duration = train_cfg.get("rollout_duration")
+
+        # exactly one returns mode (reference trainer.py:63-74)
+        assert ("reward_buff_cap" in train_cfg) ^ (
+            "beta_discount" in train_cfg
+        ), "provide exactly one of reward_buff_cap / beta_discount"
+        self.beta: float = train_cfg.get("beta_discount", 0.0)
+        self.reward_buff_cap: int = train_cfg.get("reward_buff_cap", 0)
+        if self.beta:
+            env_cfg = env_cfg | {"beta": self.beta}
+
+        self.params_env: EnvParams = env_params_from_cfg(env_cfg)
+        self.bank = make_workload_bank(
+            self.params_env.num_executors, self.params_env.max_stages,
+            **{k: v for k, v in env_cfg.items()
+               if k in ("data_dir", "bucket_size")},
+        )
+        if self.bank.max_stages != self.params_env.max_stages:
+            self.params_env = self.params_env.replace(
+                max_stages=self.bank.max_stages,
+                max_levels=max(self.params_env.max_levels,
+                               self.bank.max_stages),
+            )
+
+        # static rollout scan length
+        self.rollout_steps: int = train_cfg.get(
+            "rollout_steps", 48 * self.params_env.max_jobs
+        )
+
+        scheduler = make_scheduler(
+            agent_cfg | {"num_executors": self.params_env.num_executors}
+        )
+        assert isinstance(scheduler, TrainableScheduler), (
+            "scheduler must be trainable"
+        )
+        self.scheduler: TrainableScheduler = scheduler
+        self.tx = make_optimizer(train_cfg)
+        self.train_cfg = train_cfg
+        self._env_states = None  # async mode: persistent lanes
+
+        # SPMD over a device mesh: rollout lanes sharded along the dp axis,
+        # parameters replicated; the update's cross-lane reductions lower to
+        # XLA collectives (see parallel.py)
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel import lane_sharding
+
+            lanes = lane_sharding(mesh)
+            assert self.num_envs % mesh.size == 0, (
+                f"num_sequences*num_rollouts={self.num_envs} must divide "
+                f"evenly over {mesh.size} devices"
+            )
+            self._collect_jit = jax.jit(
+                self._collect, out_shardings=(lanes, None)
+            )
+            self._update_jit = jax.jit(
+                self._update, in_shardings=(None, lanes),
+                out_shardings=None,
+            )
+        else:
+            self._collect_jit = jax.jit(self._collect)
+            self._update_jit = jax.jit(self._update)
+
+    # ------------------------------------------------------------------
+    # device-side pieces
+    # ------------------------------------------------------------------
+
+    def init_state(self) -> TrainState:
+        params = self.scheduler.params
+        return TrainState(
+            params=params,
+            opt_state=self.tx.init(params),
+            rng=jax.random.PRNGKey(self.seed),
+            buf=(AvgNumJobsBuffer.create(self.reward_buff_cap)
+                 if self.reward_buff_cap else None),
+            iteration=jnp.zeros((), jnp.int32),
+        )
+
+    def _collect(self, model_params, iteration: jnp.ndarray,
+                 rng: jax.Array, env_states) -> tuple[Rollout, Any]:
+        """One iteration's rollouts: [B]-vmapped scans. Seed layout mirrors
+        the reference (trainer.py:268-271): lanes in the same sequence
+        group share the job-sequence key, refreshed per reset."""
+        p, bank = self.params_env, self.bank
+        G, R = self.num_sequences, self.num_rollouts
+        master = jax.random.PRNGKey(self.seed)
+
+        def seq_key(g, reset_count):
+            return jax.random.fold_in(
+                jax.random.fold_in(master, g), reset_count
+            )
+
+        g_ids = jnp.repeat(jnp.arange(G), R)
+        r_ids = jnp.tile(jnp.arange(R), G)
+        seq_rngs = jax.vmap(lambda g: seq_key(g, iteration))(g_ids)
+        lane_rngs = jax.vmap(
+            lambda s, r: jax.random.fold_in(s, 1000 + r)
+        )(seq_rngs, r_ids)
+        pol_rngs = jax.vmap(
+            lambda r: jax.random.fold_in(jax.random.fold_in(rng, r), 7)
+        )(jnp.arange(G * R))
+
+        def policy_fn(k, obs):
+            return self.scheduler.policy(k, obs, model_params)
+
+        if self.rollout_duration:  # async mode
+            if env_states is None:
+                env_states = jax.vmap(
+                    lambda s, l: core.reset_pair(p, bank, s, l)
+                )(seq_rngs, lane_rngs)
+            ro = jax.vmap(
+                lambda k, s: collect_async(
+                    p, bank, policy_fn, k, self.rollout_steps, s,
+                    self.rollout_duration,
+                )
+            )(pol_rngs, env_states)
+            return ro, ro.final_state
+        else:  # sync: fresh episode per iteration
+            states = jax.vmap(
+                lambda s, l: core.reset_pair(p, bank, s, l)
+            )(seq_rngs, lane_rngs)
+            ro = jax.vmap(
+                lambda k, s: collect_sync(
+                    p, bank, policy_fn, k, self.rollout_steps, s
+                )
+            )(pol_rngs, states)
+            return ro, None
+
+    def _returns_and_baselines(self, state: TrainState, ro: Rollout):
+        """Shared preprocessing (reference trainer.py:172-212)."""
+        T = self.rollout_steps
+        dts = step_dts(ro.wall_times)  # [B,T]
+        if self.beta:
+            returns = discounted_returns(ro.reward, dts, self.beta)
+            buf = state.buf
+            avg_num_jobs = None
+        else:
+            buf = state.buf.extend(dts, ro.reward, ro.valid)
+            avg_num_jobs = buf.avg_num_jobs()
+            returns = differential_returns(ro.reward, dts, avg_num_jobs)
+        G, R = self.num_sequences, self.num_rollouts
+        obs_times = ro.wall_times[:, :T]
+        baselines = group_baselines(
+            obs_times.reshape(G, R, T),
+            returns.reshape(G, R, T),
+            ro.valid.reshape(G, R, T),
+        ).reshape(G * R, T)
+        return returns, baselines, buf, avg_num_jobs
+
+    @abc.abstractmethod
+    def _update(self, state: TrainState, ro: Rollout):
+        """One policy update from an iteration's rollouts. Returns
+        (new TrainState, stats dict of scalars)."""
+
+    # ------------------------------------------------------------------
+    # host loop
+    # ------------------------------------------------------------------
+
+    def train(self) -> TrainState:
+        self._setup()
+        state = self.init_state()
+        best: dict[str, Any] | None = None
+
+        for i in range(self.num_iterations):
+            state = state.replace(
+                rng=jax.random.fold_in(jax.random.PRNGKey(self.seed), i)
+            )
+            ro, self._env_states = self._collect_jit(
+                state.params, state.iteration, state.rng, self._env_states
+            )
+            prev_params = state.params
+            state, stats = self._update_jit(state, ro)
+            state = state.replace(iteration=state.iteration + 1)
+
+            roll_stats = self._rollout_stats(ro)
+            avg_num_jobs = float(
+                stats.get("avg_num_jobs_est") or roll_stats["avg_num_jobs"]
+            )
+
+            if best is None or avg_num_jobs < best["avg_num_jobs"]:
+                best = {
+                    "iteration": i,
+                    "avg_num_jobs": round(avg_num_jobs, 3),
+                    "params": jax.device_get(prev_params),
+                    "completed_job_count": int(
+                        roll_stats["num_completed_jobs"]
+                    ),
+                }
+            if (i + 1) % self.checkpointing_freq == 0:
+                self._checkpoint(i, best, state)
+                best = None
+
+            host_stats = {
+                k: float(v) for k, v in stats.items()
+                if v is not None and k != "avg_num_jobs_est"
+            }
+            self._write_stats(i, host_stats | roll_stats)
+            print(
+                f"Iteration {i + 1} complete. Avg. # jobs: "
+                f"{avg_num_jobs:.3f}",
+                flush=True,
+            )
+        self._cleanup(state)
+        return state
+
+    # ------------------------------------------------------------------
+    # stats / io
+    # ------------------------------------------------------------------
+
+    def _rollout_stats(self, ro: Rollout) -> dict[str, float]:
+        fs = ro.final_state
+        return {
+            "avg_job_duration": float(
+                jax.vmap(metrics.avg_job_duration)(fs).mean()
+            ),
+            "avg_num_jobs": float(
+                jax.vmap(metrics.avg_num_jobs)(fs).mean()
+            ),
+            "num_completed_jobs": float(
+                jax.vmap(metrics.num_completed_jobs)(fs).mean()
+            ),
+            "num_job_arrivals": float(
+                jax.vmap(metrics.num_job_arrivals)(fs).mean()
+            ),
+            "episode_length": float(ro.valid.sum(-1).mean()),
+        }
+
+    def _setup(self) -> None:
+        pathlib.Path(self.artifacts_dir).mkdir(parents=True, exist_ok=True)
+        self.checkpointing_dir = osp.join(self.artifacts_dir, "checkpoints")
+        shutil.rmtree(self.checkpointing_dir, ignore_errors=True)
+        os.makedirs(self.checkpointing_dir)
+        self._tb = None
+        if self.use_tensorboard:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(osp.join(self.artifacts_dir, "tb"))
+
+    def _cleanup(self, state: TrainState) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        # always leave a resumable final state behind (the reference cannot
+        # resume: it only saves model weights, trainer.py:256-262)
+        self.save_train_state(
+            state, osp.join(self.artifacts_dir, "train_state.msgpack")
+        )
+        print("\nTraining complete.", flush=True)
+
+    def _checkpoint(self, i: int, best: dict[str, Any],
+                    state: TrainState) -> None:
+        d = osp.join(self.checkpointing_dir, f"{i + 1}")
+        os.makedirs(d, exist_ok=True)
+        with open(osp.join(d, "model.msgpack"), "wb") as fp:
+            fp.write(serialization.to_bytes(best["params"]))
+        meta = {k: v for k, v in best.items() if k != "params"}
+        with open(osp.join(d, "state.json"), "w") as fp:
+            json.dump(meta, fp)
+
+    def save_train_state(self, state: TrainState, path: str) -> None:
+        with open(path, "wb") as fp:
+            fp.write(serialization.to_bytes(jax.device_get(state)))
+
+    def load_train_state(self, path: str) -> TrainState:
+        template = self.init_state()
+        with open(path, "rb") as fp:
+            return serialization.from_bytes(template, fp.read())
+
+    def _write_stats(self, i: int, stats: dict[str, float]) -> None:
+        if self._tb is None:
+            return
+        for k, v in stats.items():
+            self._tb.add_scalar(k, v, i)
+
+
+def make_trainer(cfg: CfgType) -> Trainer:
+    """String-keyed factory (reference trainers/__init__.py:7-13)."""
+    from .ppo import PPO
+    from .vpg import VPG
+
+    registry = {"PPO": PPO, "VPG": VPG}
+    name = cfg["trainer"]["trainer_cls"]
+    if name not in registry:
+        raise ValueError(f"'{name}' is not a valid trainer.")
+    return registry[name](cfg["agent"], cfg["env"], cfg["trainer"])

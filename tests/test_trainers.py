@@ -1,0 +1,290 @@
+"""Training-layer tests: returns/baseline math against straightforward
+numpy replicas of the reference formulas, the moving-average ring buffer,
+and end-to-end PPO/VPG smoke runs."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# returns (reference trainers/utils/returns_calculator.py)
+# ---------------------------------------------------------------------------
+
+
+def _ref_discounted(rewards, dts, beta):
+    out = np.zeros(len(rewards))
+    R = 0.0
+    for k in reversed(range(len(rewards))):
+        R = rewards[k] + np.exp(-beta * 1e-3 * dts[k]) * R
+        out[k] = R
+    return out
+
+
+def _ref_differential(rewards, dts, avg_num_jobs):
+    out = np.zeros(len(rewards))
+    R = 0.0
+    for k in reversed(range(len(rewards))):
+        job_time = -rewards[k]
+        R = -(job_time - dts[k] * avg_num_jobs) + R
+        out[k] = R
+    return out
+
+
+def test_discounted_returns_matches_reference_formula():
+    import jax.numpy as jnp
+
+    from sparksched_tpu.trainers import discounted_returns, step_dts
+
+    rng = np.random.default_rng(0)
+    B, T = 3, 17
+    walls = np.cumsum(rng.exponential(100, (B, T + 1)), axis=1).astype(
+        np.float32
+    )
+    rewards = -rng.exponential(50, (B, T)).astype(np.float32)
+    beta = 5e-3
+    got = np.asarray(
+        discounted_returns(
+            jnp.asarray(rewards), step_dts(jnp.asarray(walls)), beta
+        )
+    )
+    for b in range(B):
+        want = _ref_discounted(
+            rewards[b], np.diff(walls[b]), beta
+        )
+        np.testing.assert_allclose(got[b], want, rtol=1e-4)
+
+
+def test_differential_returns_matches_reference_formula():
+    import jax.numpy as jnp
+
+    from sparksched_tpu.trainers import differential_returns
+
+    rng = np.random.default_rng(1)
+    B, T = 2, 9
+    dts = rng.exponential(100, (B, T)).astype(np.float32)
+    rewards = -rng.exponential(50, (B, T)).astype(np.float32)
+    avg = 2.37
+    got = np.asarray(
+        differential_returns(
+            jnp.asarray(rewards), jnp.asarray(dts), jnp.float32(avg)
+        )
+    )
+    for b in range(B):
+        np.testing.assert_allclose(
+            got[b], _ref_differential(rewards[b], dts[b], avg), rtol=1e-4
+        )
+
+
+def test_avg_num_jobs_buffer_matches_circular_array():
+    """Ring buffer == reference CircularArray semantics: moving window of
+    the last `cap` dt>0 steps, avg = -sum(r)/sum(dt)."""
+    import jax.numpy as jnp
+
+    from sparksched_tpu.trainers import AvgNumJobsBuffer
+
+    cap = 16
+    buf = AvgNumJobsBuffer.create(cap)
+    rng = np.random.default_rng(2)
+    window = []  # reference window of (dt, r)
+    for _ in range(5):
+        m = int(rng.integers(3, 25))
+        dts = rng.exponential(10, m)
+        dts[rng.random(m) < 0.3] = 0.0  # some zero-duration steps
+        rs = -rng.exponential(5, m)
+        valid = rng.random(m) < 0.9
+        buf = buf.extend(
+            jnp.asarray(dts, jnp.float32), jnp.asarray(rs, jnp.float32),
+            jnp.asarray(valid),
+        )
+        kept = [
+            (d, r) for d, r, v in zip(dts, rs, valid) if v and d > 0
+        ][-cap:]
+        window = (window + kept)[-cap:]
+        want = -sum(r for _, r in window) / sum(d for d, _ in window)
+        np.testing.assert_allclose(
+            float(buf.avg_num_jobs()), want, rtol=1e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# baselines (reference trainers/utils/baselines.py)
+# ---------------------------------------------------------------------------
+
+
+def _ref_baseline(ts_list, ys_list):
+    ts_unique = np.unique(np.hstack(ts_list))
+    y_hats = np.vstack(
+        [np.interp(ts_unique, ts, ys) for ts, ys in zip(ts_list, ys_list)]
+    )
+    baseline = {t: y.mean() for t, y in zip(ts_unique, y_hats.T)}
+    return [np.array([baseline[t] for t in ts]) for ts in ts_list]
+
+
+def test_group_baselines_matches_reference():
+    import jax.numpy as jnp
+
+    from sparksched_tpu.trainers import group_baselines
+
+    rng = np.random.default_rng(3)
+    G, R, T = 2, 3, 12
+    walls = np.sort(
+        rng.uniform(0, 1000, (G, R, T)).astype(np.float32), axis=-1
+    )
+    returns = rng.normal(size=(G, R, T)).astype(np.float32)
+    valid = np.ones((G, R, T), bool)
+    got = np.asarray(
+        group_baselines(
+            jnp.asarray(walls), jnp.asarray(returns), jnp.asarray(valid)
+        )
+    )
+    for g in range(G):
+        want = _ref_baseline(list(walls[g]), list(returns[g]))
+        for r in range(R):
+            np.testing.assert_allclose(got[g, r], want[r], rtol=1e-4,
+                                       atol=1e-4)
+
+
+def test_group_baselines_with_unequal_lengths():
+    """Lanes of different valid lengths: a longer lane's baseline past a
+    shorter lane's end uses the short lane's final return (np.interp
+    right-extension), like the reference's unequal episode lengths."""
+    import jax.numpy as jnp
+
+    from sparksched_tpu.trainers import group_baselines
+
+    T = 6
+    walls = np.array(
+        [[[0, 10, 20, 30, 40, 50], [0, 5, 15, 15, 15, 15]]],
+        np.float32,
+    )
+    returns = np.array(
+        [[[6, 5, 4, 3, 2, 1], [9, 8, 7, 0, 0, 0]]], np.float32
+    )
+    valid = np.array(
+        [[[1, 1, 1, 1, 1, 1], [1, 1, 1, 0, 0, 0]]], bool
+    )
+    got = np.asarray(group_baselines(
+        jnp.asarray(walls), jnp.asarray(returns), jnp.asarray(valid)
+    ))
+    want = _ref_baseline(
+        [walls[0, 0], walls[0, 1, :3]], [returns[0, 0], returns[0, 1, :3]]
+    )
+    np.testing.assert_allclose(got[0, 0], want[0], rtol=1e-4)
+    np.testing.assert_allclose(got[0, 1, :3], want[1], rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end trainer smoke tests
+# ---------------------------------------------------------------------------
+
+
+def _mini_cfg(trainer_overrides=None, env_overrides=None):
+    cfg = {
+        "trainer": {
+            "trainer_cls": "PPO",
+            "num_iterations": 1,
+            "num_sequences": 1,
+            "num_rollouts": 2,
+            "seed": 42,
+            "artifacts_dir": "/tmp/sparksched_tpu_test_artifacts",
+            "checkpointing_freq": 1,
+            "use_tensorboard": False,
+            "num_epochs": 2,
+            "num_batches": 3,
+            "clip_range": 0.2,
+            "target_kl": 0.01,
+            "entropy_coeff": 0.04,
+            "beta_discount": 5.0e-3,
+            "opt_cls": "Adam",
+            "opt_kwargs": {"lr": 3.0e-4},
+            "max_grad_norm": 0.5,
+            "rollout_steps": 60,
+        },
+        "agent": {
+            "agent_cls": "DecimaScheduler",
+            "embed_dim": 8,
+            "gnn_mlp_kwargs": {
+                "hid_dims": [16, 8],
+                "act_cls": "LeakyReLU",
+                "act_kwargs": {"negative_slope": 0.2},
+            },
+            "policy_mlp_kwargs": {"hid_dims": [16, 16], "act_cls": "Tanh"},
+        },
+        "env": {
+            "num_executors": 5,
+            "job_arrival_cap": 3,
+            "moving_delay": 2000.0,
+            "mean_time_limit": 2.0e7,
+            "job_arrival_rate": 4.0e-5,
+            "warmup_delay": 1000.0,
+        },
+    }
+    cfg["trainer"].update(trainer_overrides or {})
+    cfg["env"].update(env_overrides or {})
+    return cfg
+
+
+@pytest.mark.slow
+def test_ppo_trains_and_checkpoints(tmp_path):
+    """Mirrors the reference's only test (test/test_train.py): a full
+    train() run completes. Additionally asserts parameters changed and a
+    checkpoint + resumable train state were written."""
+    import os.path as osp
+
+    import jax
+    import numpy as np
+
+    from sparksched_tpu.trainers import make_trainer
+
+    cfg = _mini_cfg({"artifacts_dir": str(tmp_path)})
+    t = make_trainer(cfg)
+    p0 = jax.device_get(t.scheduler.params)
+    state = t.train()
+    p1 = jax.device_get(state.params)
+    changed = any(
+        not np.allclose(a, b)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)
+        )
+    )
+    assert changed, "PPO update did not change any parameter"
+    assert osp.isfile(osp.join(str(tmp_path), "checkpoints", "1",
+                               "model.msgpack"))
+    assert osp.isfile(osp.join(str(tmp_path), "train_state.msgpack"))
+    # resume round-trip
+    restored = t.load_train_state(
+        osp.join(str(tmp_path), "train_state.msgpack")
+    )
+    assert int(restored.iteration) == 1
+
+
+@pytest.mark.slow
+def test_vpg_async_differential(tmp_path):
+    import jax
+    import numpy as np
+
+    from sparksched_tpu.trainers import make_trainer
+
+    cfg = _mini_cfg(
+        {
+            "trainer_cls": "VPG",
+            "artifacts_dir": str(tmp_path),
+            "rollout_duration": 2.0e6,
+            "rollout_steps": 50,
+            "reward_buff_cap": 4000,
+        }
+    )
+    del cfg["trainer"]["beta_discount"]
+    t = make_trainer(cfg)
+    p0 = jax.device_get(t.scheduler.params)
+    state = t.train()
+    p1 = jax.device_get(state.params)
+    changed = any(
+        not np.allclose(a, b)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)
+        )
+    )
+    assert changed
