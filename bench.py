@@ -1,0 +1,119 @@
+"""Headline benchmark: env decision-steps/sec with 1024 vmapped TPC-H
+environments driven by the jitted fair scheduler on one chip
+(BASELINE.md config #4 analog; north-star target >= 50k env-steps/sec).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N/50000}
+
+The reference has no published numbers (BASELINE.md); `vs_baseline` is
+measured against the 50k steps/sec north-star target from the driver's
+BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sparksched_tpu.config import EnvParams
+from sparksched_tpu.env import core
+from sparksched_tpu.env.observe import observe
+from sparksched_tpu.schedulers.heuristics import round_robin_policy
+from sparksched_tpu.workload import make_workload_bank
+
+NUM_ENVS = 1024
+CHUNK = 256  # decision steps per timed scan
+NUM_CHUNKS = 4
+TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def bench_chunk(params: EnvParams, bank, states, rngs):
+    """CHUNK decision steps per lane; finished episodes reset in place so
+    every lane stays busy (steady-state throughput)."""
+
+    def lane(state, rng):
+        def body(carry, _):
+            st, k, n = carry
+            k, k_reset = jax.random.split(k)
+            obs = observe(params, st)
+            stage_idx, num_exec = round_robin_policy(
+                obs, params.num_executors, True
+            )
+            nxt, _, term, trunc = core.step(
+                params, bank, st, stage_idx, num_exec
+            )
+            done = term | trunc
+            # unconditional reset + select (a lane-dependent lax.cond would
+            # broadcast the bank across the batch; see env/core.py)
+            fresh = core.reset(params, bank, k_reset)
+            nxt = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(done, a, b), fresh, nxt
+            )
+            return (nxt, k, n + 1), None
+
+        (st, _, n), _ = lax.scan(
+            body, (state, rng, jnp.int32(0)), None, length=CHUNK
+        )
+        return st, n
+
+    states, counts = jax.vmap(lane)(states, rngs)
+    return states, counts.sum()
+
+
+def main() -> None:
+    params = EnvParams(
+        num_executors=10,
+        max_jobs=50,
+        max_stages=20,
+        max_levels=20,
+        moving_delay=2000.0,
+        warmup_delay=1000.0,
+        job_arrival_rate=4e-5,
+        mean_time_limit=None,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    if bank.max_stages != params.max_stages:
+        params = params.replace(
+            max_stages=bank.max_stages, max_levels=bank.max_stages
+        )
+
+    rng = jax.random.PRNGKey(0)
+    reset_keys = jax.random.split(rng, NUM_ENVS)
+    states = jax.vmap(lambda k: core.reset(params, bank, k))(reset_keys)
+    step_keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
+
+    # warmup/compile
+    states, n = bench_chunk(params, bank, states, step_keys)
+    jax.block_until_ready(n)
+
+    total = 0
+    t0 = time.perf_counter()
+    for i in range(NUM_CHUNKS):
+        keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
+        states, n = bench_chunk(params, bank, states, keys)
+        total += int(jax.block_until_ready(n))
+    dt = time.perf_counter() - t0
+
+    value = total / dt
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "env_decision_steps_per_sec_1024envs_fair_tpch"
+                ),
+                "value": round(value, 1),
+                "unit": "steps/s",
+                "vs_baseline": round(value / TARGET, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

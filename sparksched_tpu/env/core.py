@@ -107,25 +107,34 @@ def _exec_location(state: EnvState, e: jnp.ndarray):
 
 # --------------------------------------------------------------------------
 # task execution (reference :584-615)
+#
+# IMPORTANT STRUCTURAL CONSTRAINT: under `jax.vmap`, a `lax.cond`/`switch`
+# with a lane-dependent predicate broadcasts EVERY operand — including
+# closed-over constants like the workload bank's duration tables — across
+# the batch (jax _cond_batching_rule: "we broadcast the input operands for
+# simplicity"). At 1024+ lanes that materializes gigabytes. Therefore the
+# event-loop machinery below is phase-split: conditional branches only
+# touch `EnvState` and scalars, every event resolves to a small action
+# descriptor (kind, executor, target stage), and the task-duration sample —
+# the only bank access — happens UNCONDITIONALLY at loop-body top level,
+# where it is an ordinary batched gather from the shared table.
 # --------------------------------------------------------------------------
 
+# move-request kinds produced by event phase-A handlers
+RQ_NONE, RQ_START, RQ_MOVE = 0, 1, 2
+# resolved action kinds consumed by _apply_action
+A_NONE, A_START, A_SEND, A_IDLE, A_PARK = 0, 1, 2, 3, 4
 
-def _execute_next_task(
-    params: EnvParams, bank: WorkloadBank, state: EnvState,
-    e: jnp.ndarray, j: jnp.ndarray, s: jnp.ndarray
+
+def _start_task(
+    params: EnvParams, state: EnvState, e: jnp.ndarray, j: jnp.ndarray,
+    s: jnp.ndarray, dur: jnp.ndarray
 ) -> EnvState:
-    rng, sub = jax.random.split(state.rng)
-    tpl = state.job_template[j]
-    num_local = (state.exec_job == j).sum()  # len(job.local_executors)
-    same_stage = state.exec_task_stage[e] == s
-    dur = sample_task_duration(
-        params, bank, sub, tpl, s, num_local,
-        state.exec_task_valid[e], same_stage,
-    )
+    """reference _execute_next_task :584-615 with the duration pre-sampled
+    (see the structural note above)."""
     seq = state.seq_counter
     newly_saturated = state.stage_remaining[j, s] == 1
     return state.replace(
-        rng=rng,
         seq_counter=seq + 1,
         stage_remaining=state.stage_remaining.at[j, s].add(-1),
         stage_executing=state.stage_executing.at[j, s].add(1),
@@ -176,14 +185,17 @@ def _send_executor(
 # --------------------------------------------------------------------------
 
 
-def _find_backup_stage(params: EnvParams, state: EnvState, e: jnp.ndarray):
+def _find_backup_stage(params: EnvParams, state: EnvState, e: jnp.ndarray,
+                       quirk_src: jnp.ndarray):
     """Greedy local-then-global search for a stage to absorb an executor
     that arrived somewhere it is no longer needed. Reproduces the
     reference's `if not source_job_id` falsiness quirk (:521-522): when the
     executor's job id is 0, the saturation-filter exemption falls back to
-    the tracker's current source job."""
+    the tracker's source job *as it was when the reference would run this
+    search* (`quirk_src` — phase-A handlers may update the tracked source
+    before the search runs here)."""
     own = state.exec_job[e]
-    eff_src = jnp.where(own == 0, state.source_job_id(), own)
+    eff_src = jnp.where(own == 0, quirk_src, own)
     sched = find_schedulable(params, state, eff_src)
     j_cap, s_cap = sched.shape
     flat = sched.reshape(-1)
@@ -204,61 +216,83 @@ def _find_backup_stage(params: EnvParams, state: EnvState, e: jnp.ndarray):
 
 
 # --------------------------------------------------------------------------
-# executor -> stage movement (reference :799-819), with the backup layer
+# executor -> stage movement resolution (reference :699-845)
 # --------------------------------------------------------------------------
 
 
-def _mets_inner(
-    params: EnvParams, bank: WorkloadBank, state: EnvState,
-    e: jnp.ndarray, j: jnp.ndarray, s: jnp.ndarray
-) -> EnvState:
-    """_move_executor_to_stage for a stage known to have remaining tasks."""
-
-    def do_send(st: EnvState) -> EnvState:
-        return _send_executor(params, st, e, j, s)
-
-    def local(st: EnvState) -> EnvState:
-        def not_frontier(st: EnvState) -> EnvState:
-            # stage not ready yet: idle the executor in the job pool
-            return st.replace(
-                exec_task_valid=st.exec_task_valid.at[e].set(False),
-                exec_stage=st.exec_stage.at[e].set(-1),
-            )
-
-        def start(st: EnvState) -> EnvState:
-            st = st.replace(exec_stage=st.exec_stage.at[e].set(s))
-            return _execute_next_task(params, bank, st, e, j, s)
-
-        return lax.cond(st.frontier[j, s], start, not_frontier, st)
-
-    return lax.cond(state.exec_job[e] != j, do_send, local, state)
-
-
-def _move_executor_to_stage(
-    params: EnvParams, bank: WorkloadBank, state: EnvState,
-    e: jnp.ndarray, j: jnp.ndarray, s: jnp.ndarray
-) -> EnvState:
-    def saturated_path(st: EnvState) -> EnvState:
-        found, bj, bs = _find_backup_stage(params, st, e)
-
-        def backup(st: EnvState) -> EnvState:
-            # a schedulable backup stage is necessarily unsaturated, hence
-            # has remaining tasks: no second backup hop can occur
-            return _mets_inner(params, bank, st, e, bj, bs)
-
-        def idle(st: EnvState) -> EnvState:
-            pj, ps = _exec_location(st, e)
-            n = st.exec_job.shape[0]
-            return _move_idle_from_pool(st, pj, ps, _onehot(n, e))
-
-        return lax.cond(found, backup, idle, st)
-
-    def normal(st: EnvState) -> EnvState:
-        return _mets_inner(params, bank, st, e, j, s)
-
-    return lax.cond(
-        state.stage_remaining[j, s] == 0, saturated_path, normal, state
+def _resolve_action(
+    params: EnvParams, state: EnvState, req_kind: jnp.ndarray,
+    e: jnp.ndarray, rj: jnp.ndarray, rs: jnp.ndarray,
+    quirk_src: jnp.ndarray,
+):
+    """Resolve a phase-A move request into a concrete action. Pure mask
+    arithmetic over the state; the reference's nested-branch version is
+    _move_executor_to_stage (:784-845 saturated/backup layer) +
+    _mets_inner send/start/park (:799-819)."""
+    j = jnp.maximum(rj, 0)
+    s = jnp.maximum(rs, 0)
+    saturated = state.stage_remaining[j, s] == 0
+    found, bj, bs = _find_backup_stage(params, state, e, quirk_src)
+    use_backup = saturated & found
+    tj = jnp.where(use_backup, bj, j)
+    ts = jnp.where(use_backup, bs, s)
+    dead = saturated & ~found
+    send = state.exec_job[e] != tj
+    start = state.frontier[tj, ts]
+    ak_move = jnp.where(
+        dead, A_IDLE,
+        jnp.where(send, A_SEND, jnp.where(start, A_START, A_PARK)),
     )
+    ak = jnp.where(
+        req_kind == RQ_MOVE, ak_move,
+        jnp.where(req_kind == RQ_START, A_START, A_NONE),
+    )
+    tj = jnp.where(req_kind == RQ_MOVE, tj, j)
+    ts = jnp.where(req_kind == RQ_MOVE, ts, s)
+    return ak.astype(_i32), tj.astype(_i32), ts.astype(_i32)
+
+
+def _apply_action(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    ak: jnp.ndarray, e: jnp.ndarray, tj: jnp.ndarray, ts: jnp.ndarray
+) -> EnvState:
+    """Apply a resolved action. The duration is sampled unconditionally
+    here — the only bank access — so no conditional branch closes over the
+    bank tables (see structural note above). The rng is advanced once per
+    call regardless of the action kind."""
+    rng, sub = jax.random.split(state.rng)
+    state = state.replace(rng=rng)
+    e = jnp.clip(e, 0, state.exec_job.shape[0] - 1)
+    tpl = state.job_template[tj]
+    num_local = (state.exec_job == tj).sum()
+    dur = sample_task_duration(
+        params, bank, sub, tpl, ts, num_local,
+        state.exec_task_valid[e], state.exec_task_stage[e] == ts,
+    )
+
+    def none(st: EnvState) -> EnvState:
+        return st
+
+    def start(st: EnvState) -> EnvState:
+        st = st.replace(exec_stage=st.exec_stage.at[e].set(ts))
+        return _start_task(params, st, e, tj, ts, dur)
+
+    def send(st: EnvState) -> EnvState:
+        return _send_executor(params, st, e, tj, ts)
+
+    def idle(st: EnvState) -> EnvState:
+        pj, ps = _exec_location(st, e)
+        n = st.exec_job.shape[0]
+        return _move_idle_from_pool(st, pj, ps, _onehot(n, e))
+
+    def park(st: EnvState) -> EnvState:
+        # stage not ready yet: idle the executor in the job pool
+        return st.replace(
+            exec_task_valid=st.exec_task_valid.at[e].set(False),
+            exec_stage=st.exec_stage.at[e].set(-1),
+        )
+
+    return lax.switch(ak, [none, start, send, idle, park], state)
 
 
 # --------------------------------------------------------------------------
@@ -326,11 +360,13 @@ def _peek_commitment(state: EnvState, pj: jnp.ndarray, ps: jnp.ndarray):
     return match.any(), jnp.argmin(key)
 
 
-def _fulfill_commitment(
-    params: EnvParams, bank: WorkloadBank, state: EnvState,
-    e: jnp.ndarray, slot: jnp.ndarray
-) -> EnvState:
-    """reference :699-712 — consume one commitment slot with executor e."""
+def _fulfill_commitment_phase_a(
+    state: EnvState, e: jnp.ndarray, slot: jnp.ndarray
+):
+    """reference :699-712 — consume one commitment slot with executor e.
+    Pure bookkeeping + move request; the actual move is resolved/applied by
+    the caller (see structural note above). Returns
+    (state, req_kind, rj, rs)."""
     dj = state.cm_dst_job[slot]
     ds = state.cm_dst_stage[slot]
     sj = state.cm_src_job[slot]
@@ -340,25 +376,29 @@ def _fulfill_commitment(
         job_supply=state.job_supply.at[jnp.maximum(dj, 0)].add(supply_delta),
     )
 
-    def to_common(st: EnvState) -> EnvState:
+    def to_common(st: EnvState):
         pj, ps = _exec_location(st, e)
         n = st.exec_job.shape[0]
-        return _move_idle_from_pool(st, pj, ps, _onehot(n, e))
+        st = _move_idle_from_pool(st, pj, ps, _onehot(n, e))
+        return st, _i32(RQ_NONE), _i32(-1), _i32(-1)
 
-    def to_stage(st: EnvState) -> EnvState:
-        return _move_executor_to_stage(params, bank, st, e, dj, ds)
+    def to_stage(st: EnvState):
+        return st, _i32(RQ_MOVE), dj, ds
 
     return lax.cond(dj < 0, to_common, to_stage, state)
 
 
 def _fulfill_from_source(
-    params: EnvParams, bank: WorkloadBank, state: EnvState
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    active: jnp.ndarray
 ) -> EnvState:
     """reference :730-743 — match the source pool's idle executors against
-    its outstanding commitments, in commitment insertion order."""
+    its outstanding commitments, in commitment insertion order. `active`
+    masks the whole call (used to fold the reference's round-finished
+    branch into straight-line code)."""
     n = state.exec_job.shape[0]
     idle = state.source_pool_mask() & ~state.exec_executing
-    num_idle = idle.sum()
+    num_idle = jnp.where(active, idle.sum(), 0)
 
     exec_order = jnp.argsort(jnp.where(idle, jnp.arange(n), BIG_SEQ))
     match = (
@@ -371,12 +411,20 @@ def _fulfill_from_source(
     )
 
     def body(k, st: EnvState) -> EnvState:
-        def do(st: EnvState) -> EnvState:
-            return _fulfill_commitment(
-                params, bank, st, exec_order[k], slot_order[k]
-            )
+        e = exec_order[k]
+        quirk_src = st.source_job_id()
 
-        return lax.cond(k < num_idle, do, lambda s: s, st)
+        def do(st: EnvState):
+            return _fulfill_commitment_phase_a(st, e, slot_order[k])
+
+        def skip(st: EnvState):
+            return st, _i32(RQ_NONE), _i32(-1), _i32(-1)
+
+        st, rk, rj, rs = lax.cond(k < num_idle, do, skip, st)
+        ak, tj, ts = _resolve_action(
+            params, st, rk, e, rj, rs, quirk_src
+        )
+        return _apply_action(params, bank, st, ak, e, tj, ts)
 
     return lax.fori_loop(0, n, body, state)
 
@@ -408,21 +456,18 @@ def recompute_job_levels(state: EnvState, j: jnp.ndarray) -> jnp.ndarray:
 # --------------------------------------------------------------------------
 
 
-def _handle_job_arrival(
-    params: EnvParams, bank: WorkloadBank, state: EnvState, j: jnp.ndarray
-) -> EnvState:
+def _handle_job_arrival(state: EnvState, j: jnp.ndarray):
     state = state.replace(job_arrived=state.job_arrived.at[j].set(True))
     has_common = state.exec_at_common.any()
-    return state.replace(
+    state = state.replace(
         source_valid=state.source_valid | has_common,
         source_job=jnp.where(has_common, -1, state.source_job),
         source_stage=jnp.where(has_common, -1, state.source_stage),
     )
+    return state, _i32(RQ_NONE), _i32(-1), _i32(-1)
 
 
-def _handle_executor_ready(
-    params: EnvParams, bank: WorkloadBank, state: EnvState, e: jnp.ndarray
-) -> EnvState:
+def _handle_executor_ready(state: EnvState, e: jnp.ndarray):
     j = state.exec_dst_job[e]
     s = state.exec_dst_stage[e]
     state = state.replace(
@@ -432,12 +477,10 @@ def _handle_executor_ready(
         exec_job=state.exec_job.at[e].set(j),
         exec_stage=state.exec_stage.at[e].set(-1),
     )
-    return _move_executor_to_stage(params, bank, state, e, j, s)
+    return state, _i32(RQ_MOVE), j, s
 
 
-def _handle_task_finished(
-    params: EnvParams, bank: WorkloadBank, state: EnvState, e: jnp.ndarray
-) -> EnvState:
+def _handle_task_finished(state: EnvState, e: jnp.ndarray):
     j = state.exec_job[e]
     s = state.exec_task_stage[e]
     n = state.exec_job.shape[0]
@@ -450,10 +493,10 @@ def _handle_task_finished(
         exec_finish_time=state.exec_finish_time.at[e].set(INF),
     )
 
-    def more_tasks(st: EnvState) -> EnvState:
-        return _execute_next_task(params, bank, st, e, j, s)
+    def more_tasks(st: EnvState):
+        return st, _i32(RQ_START), j, s
 
-    def released(st: EnvState) -> EnvState:
+    def released(st: EnvState):
         stage_done = st.stage_completed[j, s]
         new_frontier = st.frontier[j] & ~frontier_before
         did_change = stage_done & new_frontier.any()
@@ -485,27 +528,28 @@ def _handle_task_finished(
 
         has_cm, slot = _peek_commitment(st, j, s)
 
-        def fulfill(st: EnvState) -> EnvState:
-            return _fulfill_commitment(params, bank, st, e, slot)
+        def fulfill(st: EnvState):
+            return _fulfill_commitment_phase_a(st, e, slot)
 
-        def no_cm(st: EnvState) -> EnvState:
+        def no_cm(st: EnvState):
             st = st.replace(
                 exec_task_valid=st.exec_task_valid.at[e].set(False)
             )
-            return lax.cond(
+            st = lax.cond(
                 did_change,
                 lambda s2: _move_idle_from_pool(s2, j, s, _onehot(n, e)),
                 lambda s2: s2,
                 st,
             )
+            return st, _i32(RQ_NONE), _i32(-1), _i32(-1)
 
-        st = lax.cond(has_cm, fulfill, no_cm, st)
+        st, rk, rj, rs = lax.cond(has_cm, fulfill, no_cm, st)
 
         # _update_executor_source (reference :662-674)
         set_job_pool = did_change
         set_stage_pool = ~did_change & ~has_cm
         any_set = set_job_pool | set_stage_pool
-        return st.replace(
+        st = st.replace(
             source_valid=st.source_valid | any_set,
             source_job=jnp.where(any_set, j, st.source_job),
             source_stage=jnp.where(
@@ -513,6 +557,7 @@ def _handle_task_finished(
                 jnp.where(set_stage_pool, s, st.source_stage),
             ),
         )
+        return st, rk, rj, rs
 
     return lax.cond(
         state.stage_remaining[j, s] > 0, more_tasks, released, state
@@ -554,28 +599,32 @@ def _next_event(params: EnvParams, state: EnvState):
 
 
 def _resume_simulation(
-    params: EnvParams, bank: WorkloadBank, state: EnvState
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    active: jnp.ndarray
 ) -> EnvState:
     """Pop events until there are new scheduling decisions to make or the
-    queue drains (reference :320-343)."""
+    queue drains (reference :320-343). `active` masks the whole loop."""
 
     def cond(st: EnvState) -> jnp.ndarray:
         has, _, _, _ = _next_event(params, st)
-        return has & ~st.round_ready
+        return active & has & ~st.round_ready
 
     def body(st: EnvState) -> EnvState:
         _, t, kind, arg = _next_event(params, st)
         st = st.replace(wall_time=t)
-        st = lax.switch(
+        quirk_src = st.source_job_id()
+        st, rk, rj, rs = lax.switch(
             kind,
             [
-                lambda st, a: _handle_job_arrival(params, bank, st, a),
-                lambda st, a: _handle_task_finished(params, bank, st, a),
-                lambda st, a: _handle_executor_ready(params, bank, st, a),
+                lambda st, a: _handle_job_arrival(st, a),
+                lambda st, a: _handle_task_finished(st, a),
+                lambda st, a: _handle_executor_ready(st, a),
             ],
             st,
             arg,
         )
+        ak, tj, ts = _resolve_action(params, st, rk, arg, rj, rs, quirk_src)
+        st = _apply_action(params, bank, st, ak, arg, tj, ts)
         committable = st.num_committable()
         sched = find_schedulable(params, st, st.source_job_id())
         ready = (committable > 0) & sched.any()
@@ -750,13 +799,20 @@ def step(
 
     round_continues = (state.num_committable() > 0) & state.schedulable.any()
 
-    def continue_round(st: EnvState):
-        return st, jnp.float32(0.0)
+    # The round-finished path below runs straight-line, masked by `active`,
+    # instead of under lax.cond: its body reaches the workload bank (task
+    # durations, via the event loop), and a lane-dependent cond would
+    # broadcast the bank across the vmap batch (see structural note above).
+    active = ~round_continues
 
-    def finish_round(st: EnvState):
-        st = _commit_remaining(st)
-        st = _fulfill_from_source(params, bank, st)
-        st = st.replace(
+    def commit_rest(st: EnvState) -> EnvState:
+        return _commit_remaining(st)
+
+    state = lax.cond(active, commit_rest, lambda st: st, state)
+    state = _fulfill_from_source(params, bank, state, active)
+
+    def clear_round(st: EnvState) -> EnvState:
+        return st.replace(
             source_valid=jnp.bool_(False),
             source_job=_i32(-1),
             source_stage=_i32(-1),
@@ -764,14 +820,13 @@ def step(
             round_ready=jnp.bool_(False),
             schedulable=jnp.zeros_like(st.schedulable),
         )
-        t_old = st.wall_time
-        active_old = st.job_active
-        st = _resume_simulation(params, bank, st)
-        reward = -_compute_jobtime(params, st, t_old, active_old)
-        return st, reward
 
-    state, reward = lax.cond(
-        round_continues, continue_round, finish_round, state
+    state = lax.cond(active, clear_round, lambda st: st, state)
+    t_old = state.wall_time
+    active_old = state.job_active
+    state = _resume_simulation(params, bank, state, active)
+    reward = jnp.where(
+        active, -_compute_jobtime(params, state, t_old, active_old), 0.0
     )
 
     terminated = state.all_jobs_complete

@@ -1,0 +1,89 @@
+"""Gantt-chart renderer (reference spark_sched_sim/components/renderer.py).
+
+The reference draws a live pygame window from per-executor task histories
+accumulated inside the simulator objects (renderer.py:83-117,
+executor.py:34-44) and saves `screenshot.png` on close. Device-side history
+ring buffers would bloat the vmapped env state, so here the history is
+recorded host-side by snapshotting the (tiny) executor arrays once per
+decision step of a rendered episode, and the chart is drawn with
+matplotlib: one row per executor, segments colored by job, red markers at
+job completion times, and the same summary stats text."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from . import metrics
+from .env.state import EnvState
+
+
+class GanttRenderer:
+    def __init__(self, num_executors: int) -> None:
+        self.num_executors = num_executors
+        self.times: list[float] = []
+        self.exec_job: list[np.ndarray] = []
+        self.exec_busy: list[np.ndarray] = []
+        self.final_state: EnvState | None = None
+
+    def record(self, state: EnvState) -> None:
+        """Snapshot executor assignment after an env step."""
+        self.times.append(float(state.wall_time))
+        self.exec_job.append(np.asarray(state.exec_job))
+        self.exec_busy.append(np.asarray(state.exec_executing))
+        self.final_state = state
+
+    def _segments(self):
+        """Merge consecutive snapshots into (executor, job, t0, t1) bars."""
+        segs: list[tuple[int, int, float, float]] = []
+        open_seg: dict[int, tuple[int, float]] = {}
+        for t, jobs, busy in zip(self.times, self.exec_job, self.exec_busy):
+            for e in range(self.num_executors):
+                j = int(jobs[e]) if busy[e] else -1
+                cur = open_seg.get(e)
+                if cur is not None and cur[0] != j:
+                    segs.append((e, cur[0], cur[1], t))
+                    open_seg.pop(e)
+                    cur = None
+                if cur is None and j >= 0:
+                    open_seg[e] = (j, t)
+        t_end = self.times[-1] if self.times else 0.0
+        for e, (j, t0) in open_seg.items():
+            segs.append((e, j, t0, t_end))
+        return [s for s in segs if s[3] > s[2]]
+
+    def render(self, path: str = "screenshot.png") -> str:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        segs = self._segments()
+        state = self.final_state
+        n_jobs = int(np.asarray(state.job_arrived).sum()) if state else 1
+        cmap = plt.cm.get_cmap("tab20", max(n_jobs, 1))
+
+        fig, ax = plt.subplots(
+            figsize=(12, 0.4 * self.num_executors + 2)
+        )
+        for e, j, t0, t1 in segs:
+            ax.barh(e, t1 - t0, left=t0, height=0.8,
+                    color=cmap(j % 20), edgecolor="none")
+        if state is not None:
+            t_done = np.asarray(state.job_t_completed)
+            for j in np.flatnonzero(np.isfinite(t_done)):
+                ax.axvline(t_done[j], color="red", lw=0.8, alpha=0.7)
+            ajd = float(metrics.avg_job_duration(state))
+            done = int(metrics.num_completed_jobs(state))
+            ax.set_title(
+                f"avg job duration: {ajd * 1e-3:.1f}s    "
+                f"completed jobs: {done}"
+            )
+        ax.set_xlabel("wall time (ms)")
+        ax.set_ylabel("executor")
+        ax.set_ylim(-0.5, self.num_executors - 0.5)
+        fig.tight_layout()
+        fig.savefig(path, dpi=120)
+        plt.close(fig)
+        return path

@@ -396,7 +396,17 @@ class DecimaScheduler(TrainableScheduler):
         self.params = self.init_params(jax.random.PRNGKey(seed))
         if state_dict_path:
             self.name += f":{state_dict_path}"
-            self.params = load_torch_state_dict(state_dict_path, self.params)
+            if state_dict_path.endswith(".pt"):
+                self.params = load_torch_state_dict(
+                    state_dict_path, self.params
+                )
+            else:  # flax msgpack checkpoint written by the Trainer
+                from flax import serialization
+
+                with open(state_dict_path, "rb") as fp:
+                    self.params = serialization.from_bytes(
+                        self.params, fp.read()
+                    )
         self._rng = jax.random.PRNGKey(seed)
 
     # -- parameter init ---------------------------------------------------

@@ -33,8 +33,8 @@ def _masked_mean(x, w, n):
 
 class PPO(Trainer):
     def __init__(self, agent_cfg: CfgType, env_cfg: CfgType,
-                 train_cfg: CfgType) -> None:
-        super().__init__(agent_cfg, env_cfg, train_cfg)
+                 train_cfg: CfgType, mesh=None) -> None:
+        super().__init__(agent_cfg, env_cfg, train_cfg, mesh=mesh)
         self.entropy_coeff = train_cfg.get("entropy_coeff", 0.0)
         self.clip_range = train_cfg.get("clip_range", 0.2)
         self.target_kl = train_cfg.get("target_kl", 0.01)

@@ -215,11 +215,12 @@ def collect_async(
         new_elapsed = elapsed + (nxt.wall_time - st.wall_time)
         done = term | trunc
 
-        def do_reset(_):
-            return core.reset(params, bank, k_reset)
-
-        nxt2 = lax.cond(
-            done & ~over, do_reset, lambda _: nxt, operand=None
+        # unconditional reset + tree-select rather than lax.cond: a
+        # lane-dependent cond broadcasts the closed-over workload bank
+        # across the vmap batch (see env/core.py structural note)
+        fresh = core.reset(params, bank, k_reset)
+        nxt2 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done & ~over, a, b), fresh, nxt
         )
         # budget exhausted: freeze the lane
         nxt2 = jax.tree_util.tree_map(

@@ -1,0 +1,11 @@
+"""Training entry point (reference train.py:5-7):
+    python train.py -f config/decima_tpch.yaml
+"""
+
+from sparksched_tpu.config import load
+from sparksched_tpu.trainers import make_trainer
+
+if __name__ == "__main__":
+    cfg = load()
+    trainer = make_trainer(cfg)
+    trainer.train()
