@@ -1,0 +1,56 @@
+"""Harness-level tests: example episodes, renderer output, config loader,
+and the driver entry points."""
+
+from __future__ import annotations
+
+import os.path as osp
+
+import pytest
+
+
+@pytest.mark.slow
+def test_examples_fair_episode(tmp_path, monkeypatch):
+    import examples
+
+    monkeypatch.chdir(tmp_path)
+    sched = examples.make_scheduler("fair", None)
+    avg = examples.run_episode(sched, seed=0, render=True, max_steps=4000)
+    assert avg > 0
+    assert osp.isfile(osp.join(tmp_path, "screenshot.png"))
+
+
+def test_config_loader(tmp_path):
+    import yaml
+
+    from sparksched_tpu.config import env_params_from_cfg, load
+
+    cfg_path = osp.join("/root/repo", "config", "decima_tpch.yaml")
+    with open(cfg_path) as fp:
+        cfg = yaml.safe_load(fp)
+    assert set(cfg) == {"trainer", "agent", "env"}
+    params = env_params_from_cfg(cfg["env"])
+    assert params.num_executors == 50
+    assert params.max_jobs == 200  # from job_arrival_cap
+    assert load(cfg_path) == cfg
+
+
+def test_graft_entry_compiles():
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, (params, feats) = g.entry()
+    out = jax.jit(fn)(params, feats)
+    jax.block_until_ready(out)
+    stage_scores, exec_scores = out
+    assert stage_scores.shape[:1] == exec_scores.shape[:1]
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8_devices():
+    import jax
+
+    import __graft_entry__ as g
+
+    assert len(jax.devices()) >= 8  # conftest forces 8 virtual CPU devices
+    g.dryrun_multichip(8)
