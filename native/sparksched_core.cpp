@@ -1,0 +1,732 @@
+// Host-side discrete-event Spark scheduling simulator (C ABI).
+//
+// A native single-environment engine with the same semantics as the
+// vectorized JAX core (sparksched_tpu/env/core.py) and hence as the
+// reference SparkSchedSimEnv (reference spark_sched_sim/spark_sched_sim.py:
+// commitment rounds :188-343, executor pools executor_tracker.py,
+// backup scheduling :784-845, wave-based durations data_samplers/tpch.py).
+//
+// Role in the framework: the TPU path executes thousands of envs per chip
+// under vmap; this engine is the *host runtime* — a fast CPU fallback for
+// users without accelerators, a golden cross-check for the XLA program,
+// and the single-episode evaluator used by tooling. It is deliberately a
+// third, independent implementation: C++ event heap + pool maps, not a
+// transliteration of either Python codebase.
+//
+// Exposed as a flat C ABI consumed via ctypes (sparksched_tpu/native.py).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+namespace {
+
+constexpr double kInf = 1e30;
+
+// ---------------------------------------------------------------- events
+enum EventKind : int32_t { EV_JOB = 0, EV_TASK = 1, EV_READY = 2 };
+
+struct Event {
+  double time;
+  int64_t seq;  // FIFO tie-break, mirrors heapq (reference event.py:34-35)
+  int32_t kind;
+  int32_t arg;  // job id (EV_JOB) or executor id (EV_TASK / EV_READY)
+  bool operator>(const Event& o) const {
+    if (time != o.time) return time > o.time;
+    return seq > o.seq;
+  }
+};
+
+// ------------------------------------------------------------- workload
+struct Workload {
+  int32_t num_templates = 0;
+  int32_t max_stages = 0;
+  int32_t num_levels = 0;   // executor-count levels (reference tpch.py:238)
+  int32_t bucket = 0;       // duration samples per bucket
+  std::vector<int32_t> num_stages;      // [T]
+  std::vector<int32_t> num_tasks;       // [T*S]
+  std::vector<uint8_t> adj;             // [T*S*S], row parent -> col child
+  std::vector<float> dur;               // [T*S*3*L*K]
+  std::vector<int32_t> cnt;             // [T*S*3*L]
+  std::vector<int32_t> level_values;    // [L]
+  std::vector<float> rough;             // [T*S]
+};
+
+struct Params {
+  int32_t num_executors;
+  int32_t max_jobs;
+  int32_t max_stages;
+  double moving_delay;
+  double warmup_delay;
+  uint64_t seed;
+};
+
+// --------------------------------------------------------------- entities
+struct Stage {
+  int32_t num_tasks = 0;
+  int32_t remaining = 0;
+  int32_t executing = 0;
+  int32_t completed = 0;
+  float most_recent_duration = 0.f;
+};
+
+struct Job {
+  int32_t tmpl = -1;
+  double t_arrival = 0.0;
+  double t_completed = kInf;
+  bool arrived = false;
+  std::vector<Stage> stages;
+};
+
+struct Executor {
+  int32_t job = -1;        // attached job (-1 = none)
+  int32_t stage = -1;      // stage pool residence (-1 = job/common pool)
+  bool at_common = true;
+  bool moving = false;
+  bool executing = false;
+  bool task_valid = false;  // executor.task != None in the reference
+  int32_t task_stage = -1;
+  int32_t dst_job = -1, dst_stage = -1;
+};
+
+struct Commitment {
+  int32_t src_job, src_stage, dst_job, dst_stage;
+  int64_t seq;
+  bool valid = false;
+};
+
+struct Env {
+  Params p;
+  Workload w;
+  std::priority_queue<Event, std::vector<Event>, std::greater<Event>> events;
+  int64_t seq_counter = 0;
+  double wall_time = 0.0;
+  uint64_t rng;
+
+  std::vector<Job> jobs;
+  std::vector<Executor> execs;
+  std::vector<Commitment> cms;
+  // _total_executor_count per job, maintained with the reference's exact
+  // increments incl. its staleness quirk (executor_tracker.py:146-231;
+  // mirrors EnvState.job_supply)
+  std::vector<int32_t> job_supply;
+
+  // commitment-round bookkeeping
+  bool source_valid = false;
+  int32_t source_job = -1, source_stage = -1;
+  std::vector<uint8_t> selected;     // [J*S] selected this round
+  std::vector<uint8_t> schedulable;  // [J*S]
+  bool round_ready = false;
+  bool terminated = false;
+  int32_t num_jobs = 0;
+
+  uint64_t next_rand() {  // xorshift64*
+    rng ^= rng >> 12; rng ^= rng << 25; rng ^= rng >> 27;
+    return rng * 0x2545F4914F6CDD1DULL;
+  }
+  double uniform() { return (next_rand() >> 11) * (1.0 / 9007199254740992.0); }
+};
+
+inline int32_t sidx(const Env& e, int32_t j, int32_t s) {
+  return j * e.p.max_stages + s;
+}
+
+// ------------------------------------------------- derived stage/job state
+bool stage_exists(const Env& e, int32_t j, int32_t s) {
+  return j < e.num_jobs && s < (int32_t)e.jobs[j].stages.size();
+}
+
+bool stage_completed(const Env& e, int32_t j, int32_t s) {
+  const Stage& st = e.jobs[j].stages[s];
+  return st.completed >= st.num_tasks;
+}
+
+bool job_completed(const Env& e, int32_t j) {
+  if (!e.jobs[j].arrived) return false;
+  for (size_t s = 0; s < e.jobs[j].stages.size(); s++)
+    if (!stage_completed(e, j, (int32_t)s)) return false;
+  return true;
+}
+
+bool job_active(const Env& e, int32_t j) {
+  return e.jobs[j].arrived && !job_completed(e, j);
+}
+
+int32_t commit_count_to(const Env& e, int32_t j, int32_t s) {
+  int32_t n = 0;
+  for (const auto& c : e.cms)
+    if (c.valid && c.dst_job == j && c.dst_stage == s) n++;
+  return n;
+}
+
+int32_t moving_count_to(const Env& e, int32_t j, int32_t s) {
+  int32_t n = 0;
+  for (const auto& x : e.execs)
+    if (x.moving && x.dst_job == j && x.dst_stage == s) n++;
+  return n;
+}
+
+// exec_demand / saturation (reference spark_sched_sim.py:566-582)
+int32_t exec_demand(const Env& e, int32_t j, int32_t s) {
+  return e.jobs[j].stages[s].remaining - moving_count_to(e, j, s) -
+         commit_count_to(e, j, s);
+}
+
+bool stage_saturated(const Env& e, int32_t j, int32_t s) {
+  return exec_demand(e, j, s) <= 0;
+}
+
+// a stage counts toward job saturation once all its tasks are dispatched
+bool stage_dispatched(const Env& e, int32_t j, int32_t s) {
+  return e.jobs[j].stages[s].remaining == 0;
+}
+
+bool job_saturated(const Env& e, int32_t j) {
+  for (size_t s = 0; s < e.jobs[j].stages.size(); s++)
+    if (!stage_dispatched(e, j, (int32_t)s)) return false;
+  return true;
+}
+
+// frontier: incomplete stage whose parents are all completed
+bool stage_frontier(const Env& e, int32_t j, int32_t s) {
+  if (stage_completed(e, j, s)) return false;
+  const Job& job = e.jobs[j];
+  int32_t S = e.w.max_stages;
+  int32_t sn = (int32_t)job.stages.size();
+  for (int32_t p = 0; p < sn; p++)
+    if (e.w.adj[(job.tmpl * S + p) * S + s] && !stage_completed(e, j, p))
+      return false;
+  return true;
+}
+
+// ready: unsaturated with all parents saturated (reference :542-555;
+// saturation = exec_demand <= 0, mirroring core.find_schedulable)
+bool stage_ready(const Env& e, int32_t j, int32_t s) {
+  if (stage_saturated(e, j, s)) return false;
+  const Job& job = e.jobs[j];
+  int32_t S = e.w.max_stages;
+  int32_t sn = (int32_t)job.stages.size();
+  for (int32_t p = 0; p < sn; p++)
+    if (e.w.adj[(job.tmpl * S + p) * S + s] && !stage_saturated(e, j, p))
+      return false;
+  return true;
+}
+
+// --------------------------------------------------------------- pools
+int32_t source_job_id(const Env& e) {
+  return e.source_valid ? e.source_job : -1;
+}
+
+bool in_pool(const Env& e, int32_t x, int32_t pj, int32_t ps) {
+  const Executor& ex = e.execs[x];
+  if (pj < 0) return ex.at_common;
+  if (ps < 0)
+    return ex.job == pj && ex.stage == -1 && !ex.at_common && !ex.moving;
+  return ex.job == pj && ex.stage == ps;
+}
+
+int32_t num_committable(const Env& e) {
+  if (!e.source_valid) return 0;
+  int32_t pool = 0, out = 0;
+  for (int32_t x = 0; x < e.p.num_executors; x++)
+    if (in_pool(e, x, e.source_job, e.source_stage)) pool++;
+  for (const auto& c : e.cms)
+    if (c.valid && c.src_job == e.source_job && c.src_stage == e.source_stage)
+      out++;
+  return pool - out;
+}
+
+void find_schedulable(Env& e) {
+  int32_t src = source_job_id(e);
+  std::fill(e.schedulable.begin(), e.schedulable.end(), 0);
+  for (int32_t j = 0; j < e.num_jobs; j++) {
+    if (!job_active(e, j)) continue;
+    // supply filter with source-job exemption (reference :513-522;
+    // mirrors core.find_schedulable's job_supply < num_executors)
+    bool job_ok = (j == src) || e.job_supply[j] < e.p.num_executors;
+    if (!job_ok) continue;
+    for (size_t s = 0; s < e.jobs[j].stages.size(); s++)
+      if (stage_ready(e, j, (int32_t)s) && !e.selected[sidx(e, j, (int32_t)s)])
+        e.schedulable[sidx(e, j, (int32_t)s)] = 1;
+  }
+}
+
+bool any_schedulable(const Env& e) {
+  for (uint8_t b : e.schedulable)
+    if (b) return true;
+  return false;
+}
+
+// -------------------------------------------------- duration sampling
+// (reference tpch.py:75-106,216-262; mirrors workload/sampling.py)
+float sample_duration(Env& e, int32_t tmpl, int32_t s, int32_t num_local,
+                      bool task_valid, bool same_stage, bool* warm) {
+  const Workload& w = e.w;
+  int32_t L = w.num_levels, K = w.bucket, S = w.max_stages;
+  // bracket num_local between trace executor levels
+  int32_t li = L - 1, left = -1, right = -1, left_i = 0, right_i = 0;
+  for (int32_t i = 0; i < L; i++) {
+    if (w.level_values[i] >= num_local) { right = w.level_values[i]; right_i = i; break; }
+    left = w.level_values[i]; left_i = i;
+  }
+  if (right < 0) { right = w.level_values[L - 1]; right_i = L - 1; left = right; left_i = right_i; }
+  if (left < 0) { left = right; left_i = right_i; }
+  if (left == right) li = left_i;
+  else {
+    int32_t rand_pt = 1 + (int32_t)(e.uniform() * (right - left));
+    li = (rand_pt <= num_local - left) ? left_i : right_i;
+  }
+  // fall back to the max level present for this stage when absent
+  auto cnt_at = [&](int32_t wave, int32_t lv) {
+    return w.cnt[((tmpl * S + s) * 3 + wave) * L + lv];
+  };
+  bool present = cnt_at(1, li) > 0;  // first_wave presence keys the table
+  if (!present) {
+    for (int32_t lv = L - 1; lv >= 0; lv--)
+      if (cnt_at(1, lv) > 0) { li = lv; break; }
+  }
+  // wave selection chains (reference tpch.py:75-106)
+  int32_t wave;
+  *warm = false;
+  if (!task_valid) {
+    if (cnt_at(0, li) > 0) wave = 0;
+    else { wave = 1; *warm = true; }
+  } else if (same_stage) {
+    wave = cnt_at(2, li) > 0 ? 2 : (cnt_at(1, li) > 0 ? 1 : 0);
+  } else {
+    wave = cnt_at(1, li) > 0 ? 1 : 0;
+  }
+  int32_t n = cnt_at(wave, li);
+  if (n <= 0) return w.rough[tmpl * S + s];
+  int32_t pick = (int32_t)(e.uniform() * n);
+  if (pick >= n) pick = n - 1;
+  return w.dur[(((tmpl * S + s) * 3 + wave) * L + li) * K + pick];
+}
+
+// ------------------------------------------------------ executor actions
+void move_idle_to(Env& e, int32_t x) {
+  // _move_idle_executors semantics for one executor (reference :745-782)
+  Executor& ex = e.execs[x];
+  if (ex.at_common) return;
+  if (ex.stage < 0 && !job_saturated(e, ex.job)) return;
+  if (job_saturated(e, ex.job)) {
+    ex.at_common = true;
+    ex.job = -1;
+    ex.task_valid = false;
+  }
+  ex.stage = -1;
+}
+
+void start_task(Env& e, int32_t x, int32_t j, int32_t s) {
+  Executor& ex = e.execs[x];
+  Stage& st = e.jobs[j].stages[s];
+  int32_t num_local = 0;
+  for (const auto& o : e.execs)
+    if (o.job == j) num_local++;
+  bool warm = false;
+  float d = sample_duration(e, e.jobs[j].tmpl, s, num_local, ex.task_valid,
+                            ex.task_stage == s, &warm);
+  if (warm) d += (float)e.p.warmup_delay;
+  ex.stage = s;
+  st.remaining--;
+  st.executing++;
+  st.most_recent_duration = d;
+  ex.executing = true;
+  ex.task_valid = true;
+  ex.task_stage = s;
+  e.events.push({e.wall_time + d, e.seq_counter++, EV_TASK, x});
+}
+
+void send_executor(Env& e, int32_t x, int32_t j, int32_t s) {
+  // reference :617-637
+  Executor& ex = e.execs[x];
+  e.job_supply[j]++;
+  if (ex.job >= 0) e.job_supply[ex.job]--;
+  ex.at_common = false;
+  ex.job = -1;
+  ex.stage = -1;
+  ex.task_valid = false;
+  ex.moving = true;
+  ex.dst_job = j;
+  ex.dst_stage = s;
+  e.events.push(
+      {e.wall_time + e.p.moving_delay, e.seq_counter++, EV_READY, x});
+}
+
+bool find_backup_stage(Env& e, int32_t x, int32_t quirk_src, int32_t* bj,
+                       int32_t* bs) {
+  // reference :784-845 incl. the job-id-0 falsiness quirk (:521-522)
+  int32_t own = e.execs[x].job;
+  int32_t eff_src = (own == 0) ? quirk_src : own;
+  // schedulable under eff_src as the exempt source
+  auto sched_ok = [&](int32_t j, int32_t s) {
+    if (!job_active(e, j)) return false;
+    if (j != eff_src && e.job_supply[j] >= e.p.num_executors) return false;
+    return stage_ready(e, j, s) && !e.selected[sidx(e, j, s)];
+  };
+  for (int32_t s = 0; s < (int32_t)e.jobs[std::max(own, 0)].stages.size();
+       s++)
+    if (own >= 0 && sched_ok(own, s)) { *bj = own; *bs = s; return true; }
+  for (int32_t j = 0; j < e.num_jobs; j++) {
+    if (j == own) continue;
+    for (int32_t s = 0; s < (int32_t)e.jobs[j].stages.size(); s++)
+      if (sched_ok(j, s)) { *bj = j; *bs = s; return true; }
+  }
+  return false;
+}
+
+void move_executor_to_stage(Env& e, int32_t x, int32_t j, int32_t s,
+                            int32_t quirk_src) {
+  // reference :699-845 (saturated/backup layer + send/start/park)
+  if (e.jobs[j].stages[s].remaining == 0) {
+    int32_t bj, bs;
+    if (find_backup_stage(e, x, quirk_src, &bj, &bs)) { j = bj; s = bs; }
+    else { move_idle_to(e, x); return; }
+  }
+  Executor& ex = e.execs[x];
+  if (ex.job != j) { send_executor(e, x, j, s); return; }
+  if (stage_frontier(e, j, s)) { start_task(e, x, j, s); return; }
+  ex.task_valid = false;  // park in the job pool
+  ex.stage = -1;
+}
+
+// ----------------------------------------------------------- commitments
+void add_commitment(Env& e, int32_t n, int32_t dj, int32_t ds) {
+  // inherit the sequence number of an existing (src,dst) pair so peek
+  // preserves dict-insertion order (executor_tracker.py:146-181)
+  int64_t seq = -1;
+  for (const auto& c : e.cms)
+    if (c.valid && c.src_job == e.source_job && c.src_stage == e.source_stage
+        && c.dst_job == dj && c.dst_stage == ds && (seq < 0 || c.seq < seq))
+      seq = c.seq;
+  if (seq < 0) seq = e.seq_counter++;
+  if (dj >= 0 && dj != e.source_job) e.job_supply[dj] += n;
+  for (auto& c : e.cms) {
+    if (n == 0) break;
+    if (!c.valid) {
+      c = {e.source_job, e.source_stage, dj, ds, seq, true};
+      n--;
+    }
+  }
+}
+
+bool peek_commitment(const Env& e, int32_t pj, int32_t ps, size_t* slot) {
+  int64_t best = -1;
+  for (size_t i = 0; i < e.cms.size(); i++) {
+    const auto& c = e.cms[i];
+    if (c.valid && c.src_job == pj && c.src_stage == ps &&
+        (best < 0 || c.seq < e.cms[*slot].seq)) {
+      *slot = i;
+      best = c.seq;
+    }
+  }
+  return best >= 0;
+}
+
+void fulfill_commitment(Env& e, int32_t x, size_t slot, int32_t quirk_src) {
+  int32_t dj = e.cms[slot].dst_job, ds = e.cms[slot].dst_stage;
+  if (dj >= 0 && dj != e.cms[slot].src_job) e.job_supply[dj]--;
+  e.cms[slot].valid = false;
+  if (dj < 0) { move_idle_to(e, x); return; }
+  move_executor_to_stage(e, x, dj, ds, quirk_src);
+}
+
+void commit_remaining(Env& e) {
+  int32_t n = num_committable(e);
+  if (n > 0) add_commitment(e, n, -1, -1);
+}
+
+void fulfill_from_source(Env& e) {
+  // reference :730-743
+  int32_t quirk_src = source_job_id(e);
+  std::vector<int32_t> idle;
+  for (int32_t x = 0; x < e.p.num_executors; x++)
+    if (in_pool(e, x, e.source_job, e.source_stage) && !e.execs[x].executing)
+      idle.push_back(x);
+  for (int32_t x : idle) {
+    size_t slot;
+    if (!e.source_valid ||
+        !peek_commitment(e, e.source_job, e.source_stage, &slot))
+      break;
+    fulfill_commitment(e, x, slot, quirk_src);
+  }
+}
+
+// ------------------------------------------------------------- events
+void handle_job_arrival(Env& e, int32_t j) {
+  e.jobs[j].arrived = true;
+  bool has_common = false;
+  for (const auto& x : e.execs) has_common |= x.at_common;
+  if (has_common) {
+    e.source_valid = true;
+    e.source_job = -1;
+    e.source_stage = -1;
+  }
+}
+
+void handle_executor_ready(Env& e, int32_t x) {
+  Executor& ex = e.execs[x];
+  int32_t j = ex.dst_job, s = ex.dst_stage;
+  ex.moving = false;
+  ex.at_common = false;
+  ex.job = j;
+  ex.stage = -1;
+  move_executor_to_stage(e, x, j, s, source_job_id(e));
+}
+
+void handle_task_finished(Env& e, int32_t x) {
+  Executor& ex = e.execs[x];
+  int32_t j = ex.job, s = ex.task_stage;
+  Stage& st = e.jobs[j].stages[s];
+  std::vector<uint8_t> frontier_before(e.jobs[j].stages.size());
+  for (size_t k = 0; k < frontier_before.size(); k++)
+    frontier_before[k] = stage_frontier(e, j, (int32_t)k);
+
+  st.executing--;
+  st.completed++;
+  ex.executing = false;
+
+  if (st.remaining > 0) { start_task(e, x, j, s); return; }
+
+  int32_t quirk_src = source_job_id(e);
+  bool stage_done = stage_completed(e, j, s);
+  bool did_change = false;
+  if (stage_done)
+    for (size_t k = 0; k < frontier_before.size(); k++)
+      if (!frontier_before[k] && stage_frontier(e, j, (int32_t)k))
+        did_change = true;
+
+  if (job_completed(e, j) && e.jobs[j].t_completed >= kInf) {
+    for (int32_t o = 0; o < e.p.num_executors; o++)
+      if (in_pool(e, o, j, -1) && !e.execs[o].executing) move_idle_to(e, o);
+    e.jobs[j].t_completed = e.wall_time;
+  }
+
+  size_t slot;
+  bool has_cm = peek_commitment(e, j, s, &slot);
+  if (has_cm) {
+    fulfill_commitment(e, x, slot, quirk_src);
+  } else {
+    ex.task_valid = false;
+    if (did_change) move_idle_to(e, x);
+  }
+
+  // _update_executor_source (reference :662-674)
+  if (did_change) {
+    e.source_valid = true;
+    e.source_job = j;
+    e.source_stage = -1;
+  } else if (!has_cm) {
+    e.source_valid = true;
+    e.source_job = j;
+    e.source_stage = s;
+  }
+}
+
+void resume_simulation(Env& e) {
+  while (!e.events.empty()) {
+    Event ev = e.events.top();
+    e.events.pop();
+    e.wall_time = ev.time;
+    switch (ev.kind) {
+      case EV_JOB: handle_job_arrival(e, ev.arg); break;
+      case EV_TASK: handle_task_finished(e, ev.arg); break;
+      case EV_READY: handle_executor_ready(e, ev.arg); break;
+    }
+    find_schedulable(e);
+    if (num_committable(e) > 0) {
+      if (any_schedulable(e)) { e.round_ready = true; return; }
+      // move lingering idle source executors, clear the source
+      for (int32_t x = 0; x < e.p.num_executors; x++)
+        if (in_pool(e, x, e.source_job, e.source_stage) &&
+            !e.execs[x].executing)
+          move_idle_to(e, x);
+      e.source_valid = false;
+      e.source_job = e.source_stage = -1;
+    }
+  }
+  e.terminated = true;
+  for (int32_t j = 0; j < e.num_jobs; j++)
+    if (!job_completed(e, j)) e.terminated = false;
+}
+
+double jobtime_delta(const Env& e, double t0, double t1) {
+  // reference :847-874 (beta == 0 path)
+  double total = 0.0;
+  for (int32_t j = 0; j < e.num_jobs; j++) {
+    if (!e.jobs[j].arrived) continue;
+    double a = std::max(e.jobs[j].t_arrival, t0);
+    double b = std::min(e.jobs[j].t_completed, t1);
+    if (b > a) total += b - a;
+  }
+  return total;
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------ C ABI
+extern "C" {
+
+void* ss_create(const int32_t* iparams, const double* dparams,
+                int32_t num_templates, int32_t max_stages,
+                int32_t num_levels, int32_t bucket,
+                const int32_t* num_stages, const int32_t* num_tasks,
+                const uint8_t* adj, const float* dur, const int32_t* cnt,
+                const int32_t* level_values, const float* rough) {
+  Env* e = new Env();
+  e->p.num_executors = iparams[0];
+  e->p.max_jobs = iparams[1];
+  e->p.max_stages = max_stages;
+  e->p.moving_delay = dparams[0];
+  e->p.warmup_delay = dparams[1];
+  e->p.seed = (uint64_t)iparams[2];
+  Workload& w = e->w;
+  w.num_templates = num_templates;
+  w.max_stages = max_stages;
+  w.num_levels = num_levels;
+  w.bucket = bucket;
+  w.num_stages.assign(num_stages, num_stages + num_templates);
+  w.num_tasks.assign(num_tasks, num_tasks + num_templates * max_stages);
+  w.adj.assign(adj, adj + (size_t)num_templates * max_stages * max_stages);
+  w.dur.assign(dur, dur + (size_t)num_templates * max_stages * 3 *
+                              num_levels * bucket);
+  w.cnt.assign(cnt, cnt + (size_t)num_templates * max_stages * 3 * num_levels);
+  w.level_values.assign(level_values, level_values + num_levels);
+  w.rough.assign(rough, rough + (size_t)num_templates * max_stages);
+  return e;
+}
+
+void ss_destroy(void* h) { delete (Env*)h; }
+
+// Reset with an explicit job sequence: arrivals[n], templates[n].
+void ss_reset(void* h, const double* arrivals, const int32_t* templates,
+              int32_t n_jobs) {
+  Env* e = (Env*)h;
+  e->events = {};
+  e->seq_counter = 0;
+  e->wall_time = 0.0;
+  e->rng = e->p.seed * 2654435761ULL + 1;
+  e->jobs.assign(n_jobs, Job());
+  e->num_jobs = n_jobs;
+  e->execs.assign(e->p.num_executors, Executor());
+  e->cms.assign(e->p.num_executors, Commitment());
+  e->selected.assign((size_t)e->p.max_jobs * e->p.max_stages, 0);
+  e->job_supply.assign(e->p.max_jobs, 0);
+  e->schedulable.assign((size_t)e->p.max_jobs * e->p.max_stages, 0);
+  e->round_ready = false;
+  e->terminated = false;
+  e->source_valid = false;
+  e->source_job = e->source_stage = -1;
+  for (int32_t j = 0; j < n_jobs; j++) {
+    Job& job = e->jobs[j];
+    job.tmpl = templates[j];
+    job.t_arrival = arrivals[j];
+    int32_t sn = e->w.num_stages[job.tmpl];
+    job.stages.assign(sn, Stage());
+    for (int32_t s = 0; s < sn; s++) {
+      job.stages[s].num_tasks = e->w.num_tasks[job.tmpl * e->w.max_stages + s];
+      job.stages[s].remaining = job.stages[s].num_tasks;
+      job.stages[s].most_recent_duration =
+          e->w.rough[job.tmpl * e->w.max_stages + s];
+    }
+    if (arrivals[j] == 0.0) {
+      job.arrived = true;
+    } else {
+      e->events.push({arrivals[j], e->seq_counter++, EV_JOB, j});
+    }
+  }
+  // all executors start in the common pool -> it is the source
+  e->source_valid = true;
+  e->source_job = e->source_stage = -1;
+  find_schedulable(*e);
+  e->round_ready = true;
+}
+
+// One decision step. stage_idx: flat j*max_stages+s or -1; num_exec 1-based.
+// Returns the reward; outputs via pointers.
+double ss_step(void* h, int32_t stage_idx, int32_t num_exec,
+               int32_t* terminated) {
+  Env* e = (Env*)h;
+  int32_t S = e->p.max_stages;
+  bool valid = stage_idx >= 0 && stage_idx < e->p.max_jobs * S &&
+               e->schedulable[stage_idx];
+  if (valid) {
+    int32_t j = stage_idx / S, s = stage_idx % S;
+    int32_t committable = num_committable(*e);
+    int32_t n = std::max(1, std::min(num_exec, committable));
+    n = std::min(n, exec_demand(*e, j, s));  // _adjust_num_executors
+    add_commitment(*e, n, j, s);
+    e->selected[stage_idx] = 1;
+    find_schedulable(*e);
+  } else {
+    commit_remaining(*e);
+  }
+
+  if (num_committable(*e) > 0 && any_schedulable(*e)) {
+    *terminated = 0;
+    return 0.0;  // commitment round continues at the same wall time
+  }
+
+  commit_remaining(*e);
+  fulfill_from_source(*e);
+  e->source_valid = false;
+  e->source_job = e->source_stage = -1;
+  std::fill(e->selected.begin(), e->selected.end(), 0);
+  e->round_ready = false;
+  std::fill(e->schedulable.begin(), e->schedulable.end(), 0);
+  double t0 = e->wall_time;
+  resume_simulation(*e);
+  *terminated = e->terminated ? 1 : 0;
+  return -jobtime_delta(*e, t0, e->wall_time);
+}
+
+double ss_wall_time(void* h) { return ((Env*)h)->wall_time; }
+
+// Observation into caller-allocated buffers sized [max_jobs*max_stages].
+void ss_observe(void* h, int32_t* remaining, float* duration,
+                uint8_t* schedulable, uint8_t* frontier, int32_t* supplies,
+                int32_t* committable, int32_t* source_job,
+                uint8_t* job_mask, uint8_t* node_mask) {
+  Env* e = (Env*)h;
+  int32_t S = e->p.max_stages;
+  int32_t JS = e->p.max_jobs * S;
+  std::memset(remaining, 0, JS * sizeof(int32_t));
+  std::memset(duration, 0, JS * sizeof(float));
+  std::memset(schedulable, 0, JS);
+  std::memset(frontier, 0, JS);
+  std::memset(supplies, 0, e->p.max_jobs * sizeof(int32_t));
+  std::memset(job_mask, 0, e->p.max_jobs);
+  std::memset(node_mask, 0, JS);
+  for (int32_t j = 0; j < e->num_jobs; j++) {
+    if (!job_active(*e, j)) continue;
+    job_mask[j] = 1;
+    for (size_t s = 0; s < e->jobs[j].stages.size(); s++) {
+      if (stage_completed(*e, j, (int32_t)s)) continue;
+      node_mask[j * S + s] = 1;
+      remaining[j * S + s] = e->jobs[j].stages[s].remaining;
+      duration[j * S + s] = e->jobs[j].stages[s].most_recent_duration;
+      schedulable[j * S + s] = e->schedulable[j * S + (int32_t)s];
+      frontier[j * S + s] = stage_frontier(*e, j, (int32_t)s);
+    }
+    supplies[j] = e->job_supply[j];
+  }
+  *committable = num_committable(*e);
+  *source_job = source_job_id(*e);
+}
+
+// metrics: per-job durations (min(t_done, wall) - t_arrival); -1 if not
+// arrived. Returns number of jobs.
+int32_t ss_job_durations(void* h, double* out) {
+  Env* e = (Env*)h;
+  for (int32_t j = 0; j < e->num_jobs; j++) {
+    if (!e->jobs[j].arrived) { out[j] = -1.0; continue; }
+    out[j] = std::min(e->jobs[j].t_completed, e->wall_time) -
+             e->jobs[j].t_arrival;
+  }
+  return e->num_jobs;
+}
+
+}  // extern "C"

@@ -24,3 +24,15 @@ Re-designed from scratch with the capabilities of
 __version__ = "0.1.0"
 
 from . import config  # noqa: F401
+
+# Gymnasium registration (reference spark_sched_sim/__init__.py:6), guarded
+# so the core framework works without gymnasium installed.
+try:
+    from gymnasium.envs.registration import register as _register
+
+    _register(
+        id="SparkSchedSimEnv-v0",
+        entry_point="sparksched_tpu.env.gym_compat:SparkSchedSimGymEnv",
+    )
+except Exception:  # pragma: no cover - gymnasium absent or double-register
+    pass

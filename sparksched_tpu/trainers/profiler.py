@@ -1,0 +1,42 @@
+"""Profiling utilities (reference trainers/utils/profiler.py:7-30).
+
+The reference wraps every rollout in a cProfile context manager printing
+top-N cumulative stats. Host-side Python profiling is meaningless for a
+jitted program, so `Profiler` keeps the same context-manager interface but
+reports wall time and, when a trace directory is given, captures a
+`jax.profiler` device trace viewable in TensorBoard / Perfetto."""
+
+from __future__ import annotations
+
+import time
+
+
+class Profiler:
+    """Context manager timing a block (and optionally tracing the devices).
+
+    >>> with Profiler() as p:
+    ...     rollout = collect(...)
+    >>> p.elapsed  # seconds
+    """
+
+    def __init__(self, trace_dir: str | None = None,
+                 label: str = "block") -> None:
+        self.trace_dir = trace_dir
+        self.label = label
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Profiler":
+        if self.trace_dir:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.elapsed = time.perf_counter() - self._t0
+        if self.trace_dir:
+            import jax
+
+            jax.profiler.stop_trace()
+        print(f"[profiler] {self.label}: {self.elapsed:.3f}s", flush=True)
