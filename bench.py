@@ -27,8 +27,14 @@ from sparksched_tpu.schedulers.heuristics import round_robin_policy
 from sparksched_tpu.workload import make_workload_bank
 
 NUM_ENVS = 1024
-CHUNK = 256  # decision steps per timed scan
-NUM_CHUNKS = 4
+# the tunneled v5e faults on >=1024-lane vmaps of the full step (kernel
+# fault at exactly the 8x128 tile boundary); process lanes in sub-batches
+# of 512 via lax.map inside one jit — same program, bounded vector width
+SUB_BATCH = 512
+# the tunnel also kills device programs that run for tens of seconds, so
+# keep each timed program short and accumulate across calls
+CHUNK = 16  # decision steps per timed scan
+NUM_CHUNKS = 2
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 
 
@@ -62,7 +68,17 @@ def bench_chunk(params: EnvParams, bank, states, rngs):
         )
         return st, n
 
-    states, counts = jax.vmap(lane)(states, rngs)
+    b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
+    sub = min(SUB_BATCH, b)
+    group = jax.tree_util.tree_map(
+        lambda a: a.reshape(b // sub, sub, *a.shape[1:]), (states, rngs)
+    )
+    states, counts = lax.map(
+        lambda sr: jax.vmap(lane)(sr[0], sr[1]), group
+    )
+    states = jax.tree_util.tree_map(
+        lambda a: a.reshape(b, *a.shape[2:]), states
+    )
     return states, counts.sum()
 
 

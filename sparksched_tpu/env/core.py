@@ -63,12 +63,39 @@ def find_schedulable(
         (j_idx == source_job_id)
         | (state.job_supply < params.num_executors)
     )
-    sat = state.stage_saturated
-    parent_unsat = (state.adj & (~sat & state.stage_exists)[:, :, None]).any(
-        axis=1
-    )
-    ready = state.stage_exists & ~sat & ~parent_unsat
+    # incremental caches replace the [J,S,S] reduction the reference's
+    # Python version implies (stage_sat / unsat_parent_count are updated at
+    # every demand mutation; golden recomputations checked in tests)
+    sat = state.stage_sat
+    ready = state.stage_exists & ~sat & (state.unsat_parent_count == 0)
     return job_ok[:, None] & ready & ~state.stage_selected
+
+
+def _refresh_sat(state: EnvState, j: jnp.ndarray, s: jnp.ndarray,
+                 enable: jnp.ndarray = True) -> EnvState:
+    """Recompute saturation of stage (j,s) after a demand mutation and
+    propagate the flip to its children's unsaturated-parent counts."""
+    demand = (
+        state.stage_remaining[j, s]
+        - state.moving_count[j, s]
+        - state.commit_count[j, s]
+    )
+    new = demand <= 0
+    old = state.stage_sat[j, s]
+    # only existing stages count as unsaturated parents
+    delta = jnp.where(
+        enable & state.stage_exists[j, s],
+        new.astype(_i32) - old.astype(_i32),
+        0,
+    )
+    return state.replace(
+        stage_sat=state.stage_sat.at[j, s].set(
+            jnp.where(enable, new, old)
+        ),
+        unsat_parent_count=state.unsat_parent_count.at[j].add(
+            -delta * state.adj[j, s].astype(_i32)
+        ),
+    )
 
 
 # --------------------------------------------------------------------------
@@ -134,7 +161,7 @@ def _start_task(
     (see the structural note above)."""
     seq = state.seq_counter
     newly_saturated = state.stage_remaining[j, s] == 1
-    return state.replace(
+    state = state.replace(
         seq_counter=seq + 1,
         stage_remaining=state.stage_remaining.at[j, s].add(-1),
         stage_executing=state.stage_executing.at[j, s].add(1),
@@ -150,6 +177,7 @@ def _start_task(
         ),
         exec_finish_seq=state.exec_finish_seq.at[e].set(seq),
     )
+    return _refresh_sat(state, j, s)
 
 
 def _send_executor(
@@ -163,9 +191,10 @@ def _send_executor(
     supply = supply.at[jnp.maximum(old_job, 0)].add(
         jnp.where(old_job >= 0, -1, 0)
     )
-    return state.replace(
+    state = state.replace(
         seq_counter=seq + 1,
         job_supply=supply,
+        moving_count=state.moving_count.at[j, s].add(1),
         exec_at_common=state.exec_at_common.at[e].set(False),
         exec_job=state.exec_job.at[e].set(-1),
         exec_stage=state.exec_stage.at[e].set(-1),
@@ -178,6 +207,7 @@ def _send_executor(
         ),
         exec_arrive_seq=state.exec_arrive_seq.at[e].set(seq),
     )
+    return _refresh_sat(state, j, s)
 
 
 # --------------------------------------------------------------------------
@@ -323,16 +353,23 @@ def _add_commitment(
 
     supply_delta = jnp.where((dj >= 0) & (dj != src_j), n, 0)
     supply = state.job_supply.at[jnp.maximum(dj, 0)].add(supply_delta)
+    cc = state.commit_count.at[
+        jnp.maximum(dj, 0), jnp.maximum(ds, 0)
+    ].add(jnp.where(dj >= 0, n, 0))
 
-    return state.replace(
+    state = state.replace(
         seq_counter=state.seq_counter + jnp.where(has_match, 0, 1),
         job_supply=supply,
+        commit_count=cc,
         cm_valid=state.cm_valid | take,
         cm_src_job=jnp.where(take, src_j, state.cm_src_job),
         cm_src_stage=jnp.where(take, src_s, state.cm_src_stage),
         cm_dst_job=jnp.where(take, dj, state.cm_dst_job),
         cm_dst_stage=jnp.where(take, ds, state.cm_dst_stage),
         cm_seq=jnp.where(take, seq, state.cm_seq),
+    )
+    return _refresh_sat(
+        state, jnp.maximum(dj, 0), jnp.maximum(ds, 0), enable=dj >= 0
     )
 
 
@@ -374,6 +411,12 @@ def _fulfill_commitment_phase_a(
     state = state.replace(
         cm_valid=state.cm_valid.at[slot].set(False),
         job_supply=state.job_supply.at[jnp.maximum(dj, 0)].add(supply_delta),
+        commit_count=state.commit_count.at[
+            jnp.maximum(dj, 0), jnp.maximum(ds, 0)
+        ].add(jnp.where(dj >= 0, -1, 0)),
+    )
+    state = _refresh_sat(
+        state, jnp.maximum(dj, 0), jnp.maximum(ds, 0), enable=dj >= 0
     )
 
     def to_common(st: EnvState):
@@ -434,20 +477,24 @@ def _fulfill_from_source(
 # --------------------------------------------------------------------------
 
 
-def recompute_job_levels(state: EnvState, j: jnp.ndarray) -> jnp.ndarray:
-    """i32[S]: topological generation of each active stage of job j within
-    the active subgraph (completed stages excluded), padding = S. Matches
+def compute_node_levels(params: EnvParams, state: EnvState) -> jnp.ndarray:
+    """i32[J,S]: topological generation of each active stage within the
+    ACTIVE subgraph (completed stages excluded), padding = S. Matches
     nx.topological_generations on the observed dag batch (reference
-    decima/utils.py:238-267)."""
+    decima/utils.py:238-267). Computed once per observation rather than
+    incrementally per event: a 20-deep dependent-op chain inside the event
+    while-loop was pure latency on TPU."""
     s_cap = state.stage_exists.shape[1]
-    active = state.stage_exists[j] & ~state.stage_completed[j]
-    adj_act = state.adj[j] & active[:, None] & active[None, :]
+    active = state.stage_exists & ~state.stage_completed
+    adj_act = state.adj & active[:, :, None] & active[:, None, :]
 
     def body(_, lvl):
-        cand = jnp.where(adj_act, lvl[:, None] + 1, 0).max(axis=0)
+        cand = jnp.where(adj_act, lvl[:, :, None] + 1, 0).max(axis=1)
         return jnp.maximum(lvl, cand)
 
-    lvl = lax.fori_loop(0, s_cap, body, jnp.zeros(s_cap, _i32))
+    lvl = lax.fori_loop(
+        0, s_cap, body, jnp.zeros(active.shape, _i32)
+    )
     return jnp.where(active, lvl, s_cap)
 
 
@@ -471,12 +518,14 @@ def _handle_executor_ready(state: EnvState, e: jnp.ndarray):
     j = state.exec_dst_job[e]
     s = state.exec_dst_stage[e]
     state = state.replace(
+        moving_count=state.moving_count.at[j, s].add(-1),
         exec_moving=state.exec_moving.at[e].set(False),
         exec_arrive_time=state.exec_arrive_time.at[e].set(INF),
         exec_at_common=state.exec_at_common.at[e].set(False),
         exec_job=state.exec_job.at[e].set(j),
         exec_stage=state.exec_stage.at[e].set(-1),
     )
+    state = _refresh_sat(state, j, s)
     return state, _i32(RQ_MOVE), j, s
 
 
@@ -498,6 +547,13 @@ def _handle_task_finished(state: EnvState, e: jnp.ndarray):
 
     def released(st: EnvState):
         stage_done = st.stage_completed[j, s]
+        # maintain the frontier cache: one fewer incomplete parent for
+        # every child of a completed stage
+        st = st.replace(
+            incomplete_parent_count=st.incomplete_parent_count.at[j].add(
+                -stage_done.astype(_i32) * st.adj[j, s].astype(_i32)
+            )
+        )
         new_frontier = st.frontier[j] & ~frontier_before
         did_change = stage_done & new_frontier.any()
         job_done = st.job_completed[j]
@@ -512,18 +568,6 @@ def _handle_task_finished(state: EnvState, e: jnp.ndarray):
         st = lax.cond(
             job_done & jnp.isinf(st.job_t_completed[j]),
             complete_job, lambda s2: s2, st,
-        )
-
-        # the active subgraph changed: refresh job j's topological levels
-        st = lax.cond(
-            stage_done,
-            lambda s2: s2.replace(
-                node_level=s2.node_level.at[j].set(
-                    recompute_job_levels(s2, j)
-                )
-            ),
-            lambda s2: s2,
-            st,
         )
 
         has_cm, slot = _peek_commitment(st, j, s)
@@ -737,7 +781,15 @@ def reset_from_sequence(
     rough = jnp.where(exists, bank.rough_duration[templates], 0.0)
     adj = bank.adj[templates] & exists[:, :, None] & exists[:, None, :]
 
+    sat0 = ntasks <= 0  # padding rows and empty stages start saturated
+    unsat0 = (
+        (adj & (~sat0 & exists)[:, :, None]).sum(axis=1)
+    ).astype(jnp.int32)
+    ipc0 = adj.sum(axis=1).astype(jnp.int32)
     state = state.replace(
+        stage_sat=sat0,
+        unsat_parent_count=unsat0,
+        incomplete_parent_count=ipc0,
         time_limit=time_limit,
         seq_counter=num_jobs,
         job_template=templates,
@@ -750,9 +802,6 @@ def reset_from_sequence(
         stage_remaining=ntasks,
         stage_duration=rough,
         adj=adj,
-        node_level=jnp.where(
-            exists, bank.node_level[templates], s_cap
-        ).astype(_i32),
     )
 
     # _load_initial_jobs (reference :260-273): pop all t=0 arrivals

@@ -16,6 +16,7 @@ from flax import struct
 
 from ..config import EnvParams
 from .state import EnvState
+from . import core as _core
 
 NUM_NODE_FEATURES = 3  # reference spark_sched_sim.py:25
 
@@ -68,7 +69,7 @@ def observe(params: EnvParams, state: EnvState) -> Observation:
         schedulable=state.schedulable & node_mask,
         frontier=state.frontier & node_mask,
         adj=state.adj,
-        node_level=state.node_level,
+        node_level=_core.compute_node_levels(params, state),
         exec_supplies=jnp.where(job_mask, state.job_supply, 0),
         num_committable=state.num_committable(),
         source_job=state.source_job_id(),

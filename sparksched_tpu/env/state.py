@@ -77,11 +77,6 @@ class EnvState(struct.PyTreeNode):
     stage_selected: jnp.ndarray  # bool[J,S]; selected this scheduling round
     schedulable: jnp.ndarray  # bool[J,S]; saved schedulable set for round
     adj: jnp.ndarray  # bool[J,S,S]; adj[j,p,c] == True iff edge p->c
-    node_level: jnp.ndarray  # i32[J,S]; topological generation of each
-    # active stage within the ACTIVE subgraph (completed stages excluded),
-    # padding = S. Maintained incrementally on stage completion — the
-    # vectorized equivalent of the reference Decima wrapper's cached
-    # edge-mask batches (schedulers/decima/env_wrapper.py:49-54,145-162)
 
     # --- executors [N] ---
     exec_at_common: jnp.ndarray  # bool[N]
@@ -97,6 +92,25 @@ class EnvState(struct.PyTreeNode):
     exec_task_stage: jnp.ndarray  # i32[N]; stage of current/last task
     exec_finish_time: jnp.ndarray  # f32[N]; inf if not executing
     exec_finish_seq: jnp.ndarray  # i32[N]
+
+    # --- incremental scheduling caches [J,S] ---
+    # stage saturation and per-stage parent counts, maintained at the few
+    # mutation points instead of recomputed via [J,S,S] reductions on every
+    # find_schedulable/frontier access inside the event loop (the dominant
+    # TPU cost before this; the golden recomputations remain as properties
+    # for invariant tests)
+    stage_sat: jnp.ndarray  # bool[J,S]; exec_demand <= 0
+    unsat_parent_count: jnp.ndarray  # i32[J,S]; parents with ~sat & exists
+    incomplete_parent_count: jnp.ndarray  # i32[J,S]; parents not completed
+
+    # --- incremental executor-flow counters [J,S] ---
+    # the reference maintains these as dicts (_num_commitments_to_stage /
+    # _num_moving_to_stage, executor_tracker.py); recomputing them by
+    # scatter on every find_schedulable call dominated the event loop on
+    # TPU (scatters serialize), so they are first-class state updated at
+    # the four mutation points (commit add/consume, send, arrival)
+    commit_count: jnp.ndarray  # i32[J,S]
+    moving_count: jnp.ndarray  # i32[J,S]
 
     # --- commitment slots [N] ---
     cm_valid: jnp.ndarray  # bool[N]
@@ -141,18 +155,28 @@ class EnvState(struct.PyTreeNode):
     @property
     def frontier(self) -> jnp.ndarray:
         """bool[J,S]; incomplete stages whose parents all completed
-        (reference job.py:24-26, maintained incrementally there; derived
-        here). Identical to "no incoming edges in the active subgraph"
-        computed by heuristic preprocessing (schedulers/heuristics/
-        utils.py:5-14)."""
+        (reference job.py:24-26, maintained incrementally there AND here,
+        via `incomplete_parent_count`). Identical to "no incoming edges in
+        the active subgraph" computed by heuristic preprocessing
+        (schedulers/heuristics/utils.py:5-14)."""
+        return (
+            self.stage_exists
+            & ~self.stage_completed
+            & (self.incomplete_parent_count == 0)
+        )
+
+    @property
+    def frontier_golden(self) -> jnp.ndarray:
+        """Recomputed frontier for invariant tests."""
         incomplete_parent = self.adj & ~self.stage_completed[:, :, None]
         blocked = incomplete_parent.any(axis=1)
         return self.stage_exists & ~self.stage_completed & ~blocked
 
     @property
     def commit_count_to_stage(self) -> jnp.ndarray:
-        """i32[J,S]; _num_commitments_to_stage, derived by scatter over
-        slots."""
+        """i32[J,S]; slot-derived commitment counts — the slow golden
+        version of the incremental `commit_count` field, kept for
+        invariant checks in tests."""
         j_cap, s_cap = self.stage_exists.shape
         flat = jnp.zeros(j_cap * s_cap + 1, dtype=jnp.int32)
         idx = jnp.where(
@@ -165,7 +189,8 @@ class EnvState(struct.PyTreeNode):
 
     @property
     def moving_count_to_stage(self) -> jnp.ndarray:
-        """i32[J,S]; _num_moving_to_stage, derived from moving executors."""
+        """i32[J,S]; executor-derived moving counts — golden version of
+        the incremental `moving_count` field, for invariant checks."""
         j_cap, s_cap = self.stage_exists.shape
         flat = jnp.zeros(j_cap * s_cap + 1, dtype=jnp.int32)
         idx = jnp.where(
@@ -181,12 +206,13 @@ class EnvState(struct.PyTreeNode):
         """i32[J,S]; remaining tasks minus (moving + committed) executors
         (reference spark_sched_sim.py:566-578). Can be negative."""
         return self.stage_remaining - (
-            self.moving_count_to_stage + self.commit_count_to_stage
+            self.moving_count + self.commit_count
         )
 
     @property
     def stage_saturated(self) -> jnp.ndarray:
-        """bool[J,S] (reference :580-582)."""
+        """bool[J,S] (reference :580-582). Golden recomputation of the
+        incremental `stage_sat` field."""
         return self.exec_demand <= 0
 
     @property
@@ -265,7 +291,6 @@ def empty_state(params: EnvParams, rng: jax.Array) -> EnvState:
         stage_selected=jnp.zeros((j, s), bool),
         schedulable=jnp.zeros((j, s), bool),
         adj=jnp.zeros((j, s, s), bool),
-        node_level=jnp.full((j, s), s, i32),
         exec_at_common=jnp.ones(n, bool),
         exec_job=jnp.full(n, -1, i32),
         exec_stage=jnp.full(n, -1, i32),
@@ -279,6 +304,11 @@ def empty_state(params: EnvParams, rng: jax.Array) -> EnvState:
         exec_task_stage=jnp.full(n, -1, i32),
         exec_finish_time=jnp.full(n, INF),
         exec_finish_seq=jnp.zeros(n, i32),
+        stage_sat=jnp.ones((j, s), bool),
+        unsat_parent_count=jnp.zeros((j, s), i32),
+        incomplete_parent_count=jnp.zeros((j, s), i32),
+        commit_count=jnp.zeros((j, s), i32),
+        moving_count=jnp.zeros((j, s), i32),
         cm_valid=jnp.zeros(n, bool),
         cm_src_job=jnp.full(n, -1, i32),
         cm_src_stage=jnp.full(n, -1, i32),
