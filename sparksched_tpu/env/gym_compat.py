@@ -9,6 +9,7 @@ golden parity tests.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any
 
 import numpy as np
@@ -178,3 +179,75 @@ class SparkSchedSimGymEnv(gym.Env if _GYM else object):
 
     def _info(self) -> dict[str, Any]:
         return {"wall_time": float(self.state.wall_time)}
+
+
+class SparkSchedSimVectorEnv:
+    """Vectorized batch of environments — the TPU-native counterpart of
+    `gym.vector.VectorEnv`. Observations are the padded `Observation`
+    pytree with a leading [B] axis; actions are flat padded stage indices
+    and 1-based executor counts, [B] each. Episodes auto-reset.
+
+    This is the thin host-facing layer over exactly the machinery the
+    trainers use internally (vmapped reset/step + masked auto-reset)."""
+
+    def __init__(self, num_envs: int, env_cfg: dict[str, Any],
+                 bank: WorkloadBank | None = None) -> None:
+        self.num_envs = num_envs
+        self.params = env_params_from_cfg(env_cfg)
+        self.bank = bank if bank is not None else make_workload_bank(
+            self.params.num_executors, self.params.max_stages,
+            **{k: v for k, v in env_cfg.items()
+               if k in ("data_dir", "seed", "bucket_size")},
+        )
+        if self.bank.max_stages != self.params.max_stages:
+            self.params = self.params.replace(
+                max_stages=self.bank.max_stages,
+                max_levels=max(self.params.max_levels,
+                               self.bank.max_stages),
+            )
+        params, bank_ = self.params, self.bank
+
+        def _reset(rngs):
+            return jax.vmap(lambda k: core.reset(params, bank_, k))(rngs)
+
+        def _step(states, stage_idx, num_exec, reset_rngs):
+            def one(st, si, ne, rk):
+                nxt, r, term, trunc = core.step(params, bank_, st, si, ne)
+                done = term | trunc
+                fresh = core.reset(params, bank_, rk)
+                nxt = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(done, a, b), fresh, nxt
+                )
+                return nxt, r, term, trunc
+
+            states, r, term, trunc = jax.vmap(one)(
+                states, stage_idx, num_exec, reset_rngs
+            )
+            return states, observe_batch(params, states), r, term, trunc
+
+        self._reset_jit = jax.jit(_reset)
+        self._step_jit = jax.jit(_step)
+        self.states = None
+        self._rng = jax.random.PRNGKey(0)
+
+    def reset(self, seed: int = 0):
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, sub = jax.random.split(self._rng)
+        self.states = self._reset_jit(
+            jax.random.split(sub, self.num_envs)
+        )
+        return observe_batch(self.params, self.states)
+
+    def step(self, stage_idx, num_exec):
+        self._rng, sub = jax.random.split(self._rng)
+        self.states, obs, r, term, trunc = self._step_jit(
+            self.states, jnp.asarray(stage_idx, jnp.int32),
+            jnp.asarray(num_exec, jnp.int32),
+            jax.random.split(sub, self.num_envs),
+        )
+        return obs, r, term, trunc
+
+
+@partial(jax.jit, static_argnums=0)
+def observe_batch(params: EnvParams, states) -> Observation:
+    return jax.vmap(lambda s: observe(params, s))(states)

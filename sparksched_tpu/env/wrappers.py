@@ -1,0 +1,35 @@
+"""Wrapper-compatible shims (reference spark_sched_sim/wrappers/).
+
+The reference composes Gymnasium wrappers around its env; here their
+semantics live in the core (fixed shapes demand it), and these shims keep
+the reference's wrapper API for drop-in use:
+
+- StochasticTimeLimit (reference wrappers/stochastic_time_limit.py:5-31):
+  the per-episode Exponential(mean_time_limit) horizon is sampled inside
+  `core.reset` — this wrapper just configures it on a gym-compat env.
+- DecimaObsWrapper's feature pipeline (reference schedulers/decima/
+  env_wrapper.py) is `schedulers.decima.build_features`, applied inside
+  the policy so rollouts stay on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .gym_compat import SparkSchedSimGymEnv
+
+
+class StochasticTimeLimit:
+    """Configures the exponential episode horizon on a gym-compat env
+    (reference wrappers/stochastic_time_limit.py:5-31). Usage:
+
+        env = StochasticTimeLimit(env, mean_time_limit=2e7)
+    """
+
+    def __init__(self, env: SparkSchedSimGymEnv,
+                 mean_time_limit: float) -> None:
+        self.env = env
+        env.params = env.params.replace(mean_time_limit=mean_time_limit)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.env, name)

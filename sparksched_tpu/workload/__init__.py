@@ -30,3 +30,8 @@ def make_workload_bank(
     else:
         templates = make_templates(seed=seed, bucket_size=bucket_size)
     return pack_bank(templates, num_executors, max_stages, bucket_size)
+
+
+# drop-in alias for the reference factory name
+# (spark_sched_sim/data_samplers/__init__.py:9-15)
+make_data_sampler = make_workload_bank
