@@ -1,0 +1,315 @@
+"""Flat micro-step execution engine.
+
+`core.step` drives one decision at a time: the event loop between
+decisions is a `lax.while_loop`, and under `jax.vmap` every lane pays the
+*maximum* event count over the batch per decision (measured ~6x the mean
+at 64 lanes — the straggler tax of lockstep scanning). This engine
+flattens the whole simulation into identical micro-steps —
+
+    DECIDE   one policy commitment (or round finish)
+    FULFILL  one source-pool commitment fulfillment
+    EVENT    one event pop + handling
+
+— so every lane advances by one unit of work on every iteration and no
+lane ever idles waiting for a straggler. Semantics are identical to the
+`core.step` loop (same phase-split helpers, same ordering); the flat-vs-
+step equivalence is asserted by tests/test_flat_loop.py.
+
+Used by bench/eval paths where only final states and decision counts
+matter; trainers keep the per-decision scan (they must record per-decision
+buffers at fixed offsets).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from ..config import EnvParams
+from ..workload.bank import WorkloadBank
+from .core import (
+    RQ_NONE,
+    _add_commitment,
+    _apply_action,
+    _commit_remaining,
+    _fulfill_commitment_phase_a,
+    _handle_executor_ready,
+    _handle_job_arrival,
+    _handle_task_finished,
+    _move_idle_from_pool,
+    _next_event,
+    _resolve_action,
+    find_schedulable,
+)
+from .observe import observe
+from .state import BIG_SEQ, EnvState
+
+_i32 = jnp.int32
+
+M_DECIDE, M_FULFILL, M_EVENT = 0, 1, 2
+
+
+class LoopState(struct.PyTreeNode):
+    env: EnvState
+    mode: jnp.ndarray  # i32 []
+    fulfill_k: jnp.ndarray  # i32 []
+    num_idle: jnp.ndarray  # i32 []
+    exec_order: jnp.ndarray  # i32[N]
+    slot_order: jnp.ndarray  # i32[N]
+    decisions: jnp.ndarray  # i32 []; decision micro-steps taken
+    episodes: jnp.ndarray  # i32 []; completed episodes
+
+
+def init_loop_state(state: EnvState) -> LoopState:
+    n = state.exec_job.shape[0]
+    return LoopState(
+        env=state,
+        mode=_i32(M_DECIDE),
+        fulfill_k=_i32(0),
+        num_idle=_i32(0),
+        exec_order=jnp.zeros(n, _i32),
+        slot_order=jnp.zeros(n, _i32),
+        decisions=_i32(0),
+        episodes=_i32(0),
+    )
+
+
+def _clear_round(st: EnvState) -> EnvState:
+    return st.replace(
+        source_valid=jnp.bool_(False),
+        source_job=_i32(-1),
+        source_stage=_i32(-1),
+        stage_selected=jnp.zeros_like(st.stage_selected),
+        round_ready=jnp.bool_(False),
+        schedulable=jnp.zeros_like(st.schedulable),
+    )
+
+
+def micro_step(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: Callable,
+    ls: LoopState,
+    rng: jax.Array,
+    auto_reset: bool = True,
+) -> LoopState:
+    """One unit of work for one lane (vmap over lanes)."""
+    k_pol, k_reset = jax.random.split(rng)
+    st = ls.env
+    n = st.exec_job.shape[0]
+    s_cap = params.max_stages
+
+    # ---- DECIDE: one commitment from the policy (core.step's front half)
+    def decide(ls: LoopState):
+        obs = observe(params, ls.env)
+        stage_idx, num_exec, _ = policy_fn(k_pol, obs)
+        st = ls.env
+        j, s = stage_idx // s_cap, stage_idx % s_cap
+        valid = (
+            (stage_idx >= 0)
+            & (stage_idx < params.num_nodes)
+            & st.schedulable[j, s]
+        )
+
+        def do_commit(stt: EnvState) -> EnvState:
+            committable = stt.num_committable()
+            nn = jnp.clip(num_exec, 1, committable)
+            nn = jnp.minimum(nn, stt.exec_demand[j, s])
+            stt = _add_commitment(stt, nn, j, s)
+            stt = stt.replace(
+                stage_selected=stt.stage_selected.at[j, s].set(True)
+            )
+            return stt.replace(
+                schedulable=find_schedulable(
+                    params, stt, stt.source_job_id()
+                )
+            )
+
+        st = lax.cond(valid, do_commit, _commit_remaining, st)
+        round_continues = (
+            (st.num_committable() > 0) & st.schedulable.any()
+        )
+
+        def finish(st: EnvState):
+            st = _commit_remaining(st)
+            idle = st.source_pool_mask() & ~st.exec_executing
+            num_idle = idle.sum().astype(_i32)
+            exec_order = jnp.argsort(
+                jnp.where(idle, jnp.arange(n), BIG_SEQ)
+            ).astype(_i32)
+            match = (
+                st.cm_valid
+                & (st.cm_src_job == st.source_job)
+                & (st.cm_src_stage == st.source_stage)
+            )
+            slot_order = jnp.argsort(
+                jnp.where(match, st.cm_seq, BIG_SEQ), stable=True
+            ).astype(_i32)
+            # empty fulfillment: clear and go straight to events
+            st = lax.cond(
+                num_idle == 0, _clear_round, lambda x: x, st
+            )
+            mode = jnp.where(num_idle == 0, M_EVENT, M_FULFILL)
+            return st, mode.astype(_i32), num_idle, exec_order, slot_order
+
+        def stay(st: EnvState):
+            return (
+                st, _i32(M_DECIDE), _i32(0), ls.exec_order, ls.slot_order
+            )
+
+        st, mode, num_idle, eo, so = lax.cond(
+            round_continues, stay, finish, st
+        )
+        return ls.replace(
+            env=st,
+            mode=mode,
+            fulfill_k=_i32(0),
+            num_idle=num_idle,
+            exec_order=eo,
+            slot_order=so,
+            decisions=ls.decisions + 1,
+        ), _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(0), st.source_job_id()
+
+    # ---- FULFILL: one commitment fulfillment (core._fulfill_from_source
+    # body, one k per micro-step)
+    def fulfill(ls: LoopState):
+        st = ls.env
+        k = ls.fulfill_k
+        e = ls.exec_order[k]
+        quirk = st.source_job_id()
+
+        def do(st: EnvState):
+            return _fulfill_commitment_phase_a(st, e, ls.slot_order[k])
+
+        def skip(st: EnvState):
+            return st, _i32(RQ_NONE), _i32(-1), _i32(-1)
+
+        st, rk, rj, rs = lax.cond(k < ls.num_idle, do, skip, st)
+        last = k + 1 >= ls.num_idle
+        st = lax.cond(last, _clear_round, lambda x: x, st)
+        mode = jnp.where(last, M_EVENT, M_FULFILL).astype(_i32)
+        return ls.replace(env=st, mode=mode, fulfill_k=k + 1), rk, rj, rs, \
+            e, quirk
+
+    # ---- EVENT: one event pop + handling (core._resume_simulation body)
+    def event(ls: LoopState):
+        st = ls.env
+        has, t, kind, arg = _next_event(params, st)
+
+        def pop(st: EnvState):
+            st = st.replace(wall_time=t)
+            quirk = st.source_job_id()
+            st, rk, rj, rs = lax.switch(
+                kind,
+                [
+                    lambda st, a: _handle_job_arrival(st, a),
+                    lambda st, a: _handle_task_finished(st, a),
+                    lambda st, a: _handle_executor_ready(st, a),
+                ],
+                st,
+                arg,
+            )
+            return st, rk, rj, rs, quirk
+
+        def drained(st: EnvState):
+            return st, _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(-1)
+
+        st, rk, rj, rs, quirk = lax.cond(has, pop, drained, st)
+        return ls.replace(env=st), rk, rj, rs, arg, quirk
+
+    ls2, rk, rj, rs, e, quirk = lax.switch(
+        ls.mode, [decide, fulfill, event], ls
+    )
+    st = ls2.env
+
+    # shared move resolution + application (the only bank access)
+    ak, tj, ts = _resolve_action(params, st, rk, e, rj, rs, quirk)
+    st = _apply_action(params, bank, st, ak, e, tj, ts)
+
+    # post-event round-ready check (core._resume_simulation :tail), only
+    # meaningful after EVENT micro-steps
+    is_event = ls.mode == M_EVENT
+    committable = st.num_committable()
+    sched = find_schedulable(params, st, st.source_job_id())
+    ready = is_event & (committable > 0) & sched.any()
+
+    def set_ready(st: EnvState) -> EnvState:
+        return st.replace(round_ready=jnp.bool_(True), schedulable=sched)
+
+    def not_ready(st: EnvState) -> EnvState:
+        def move_and_clear(st: EnvState) -> EnvState:
+            idle = st.source_pool_mask() & ~st.exec_executing
+            st = _move_idle_from_pool(
+                st, st.source_job, st.source_stage, idle
+            )
+            return st.replace(
+                source_valid=jnp.bool_(False),
+                source_job=_i32(-1),
+                source_stage=_i32(-1),
+            )
+
+        return lax.cond(
+            is_event & (committable > 0), move_and_clear,
+            lambda x: x, st,
+        )
+
+    st = lax.cond(ready, set_ready, not_ready, st)
+    mode = jnp.where(ready, M_DECIDE, ls2.mode).astype(_i32)
+
+    # episode end: auto-reset (unconditional reset + select keeps the
+    # workload bank out of lane-dependent conditionals); with
+    # auto_reset=False finished lanes freeze instead (tests, evals)
+    done = st.all_jobs_complete | (st.wall_time >= st.time_limit)
+    was_done = (
+        ls.env.all_jobs_complete
+        | (ls.env.wall_time >= ls.env.time_limit)
+    )
+    if auto_reset:
+        from . import core as _core
+
+        fresh = _core.reset(params, bank, k_reset)
+        st = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done, a, b), fresh, st
+        )
+        mode = jnp.where(done, M_DECIDE, mode).astype(_i32)
+    else:
+        st = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(was_done, a, b), ls.env, st
+        )
+        ls2 = ls2.replace(
+            decisions=jnp.where(
+                was_done, ls.decisions, ls2.decisions
+            ).astype(_i32)
+        )
+    return ls2.replace(
+        env=st,
+        mode=mode,
+        episodes=ls2.episodes + (done & ~was_done).astype(_i32),
+    )
+
+
+def run_flat(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: Callable,
+    rng: jax.Array,
+    num_micro_steps: int,
+    state: EnvState,
+    auto_reset: bool = True,
+) -> LoopState:
+    """Scan `num_micro_steps` micro-steps for one lane (vmap over lanes)."""
+    ls = init_loop_state(state)
+
+    def body(carry, _):
+        ls, k = carry
+        k, sub = jax.random.split(k)
+        return (
+            micro_step(params, bank, policy_fn, ls, sub, auto_reset), k
+        ), None
+
+    (ls, _), _ = lax.scan(body, (ls, rng), None, length=num_micro_steps)
+    return ls

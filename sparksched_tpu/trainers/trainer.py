@@ -252,12 +252,22 @@ class Trainer(abc.ABC):
     # host loop
     # ------------------------------------------------------------------
 
-    def train(self) -> TrainState:
-        self._setup()
-        state = self.init_state()
+    def train(self, resume_from: str | None = None) -> TrainState:
+        """Run `num_iterations` more iterations, optionally resuming a
+        saved full train state (params + optimizer + returns window + RNG +
+        iteration counter) — the resume capability the reference lacks
+        (its checkpoints are model weights only, trainer.py:256-262)."""
+        self._setup(fresh=resume_from is None)
+        if resume_from:
+            state = self.load_train_state(resume_from)
+            print(f"Resumed from {resume_from} at iteration "
+                  f"{int(state.iteration)}.", flush=True)
+        else:
+            state = self.init_state()
         best: dict[str, Any] | None = None
+        start = int(state.iteration)
 
-        for i in range(self.num_iterations):
+        for i in range(start, start + self.num_iterations):
             state = state.replace(
                 rng=jax.random.fold_in(jax.random.PRNGKey(self.seed), i)
             )
@@ -321,11 +331,12 @@ class Trainer(abc.ABC):
             "episode_length": float(ro.valid.sum(-1).mean()),
         }
 
-    def _setup(self) -> None:
+    def _setup(self, fresh: bool = True) -> None:
         pathlib.Path(self.artifacts_dir).mkdir(parents=True, exist_ok=True)
         self.checkpointing_dir = osp.join(self.artifacts_dir, "checkpoints")
-        shutil.rmtree(self.checkpointing_dir, ignore_errors=True)
-        os.makedirs(self.checkpointing_dir)
+        if fresh:
+            shutil.rmtree(self.checkpointing_dir, ignore_errors=True)
+        os.makedirs(self.checkpointing_dir, exist_ok=True)
         self._tb = None
         if self.use_tensorboard:
             from torch.utils.tensorboard import SummaryWriter
