@@ -1,19 +1,19 @@
 import os
+import sys
 
 # Run the test suite on a virtual 8-device CPU mesh so multi-chip sharding
 # is exercised without TPU hardware. The interpreter in this image preloads
 # jax with JAX_PLATFORMS=axon (real TPU), so env vars alone are too late —
-# jax.config still works as long as no computation has initialized the
-# backend yet.
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+# the shared helper in __graft_entry__ flips jax.config in-process before
+# any computation initializes the backend.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from __graft_entry__ import force_virtual_cpu_devices  # noqa: E402
+
+force_virtual_cpu_devices(8)
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.default_backend()
