@@ -1,6 +1,7 @@
-"""Headline benchmark: env decision-steps/sec with 1024 vmapped TPC-H
-environments driven by the jitted fair scheduler on one chip
-(BASELINE.md config #4 analog; north-star target >= 50k env-steps/sec).
+"""Headline benchmark: env decision-steps/sec with 1024 vmapped
+environments (synthetic TPC-H-shaped workload bank) driven by the jitted
+fair scheduler on one chip (BASELINE.md config #4 analog; north-star
+target >= 50k env-steps/sec).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N/50000}
@@ -8,6 +9,12 @@ Prints ONE JSON line:
 The reference has no published numbers (BASELINE.md); `vs_baseline` is
 measured against the 50k steps/sec north-star target from the driver's
 BASELINE.json.
+
+Engine: the flat micro-step loop (env/flat_loop.py) — every lane advances
+by one unit of work (decide / fulfill / event) per iteration, so no lane
+pays the batch-max event count of the per-decision `core.step` while_loop
+(the ~6x straggler tax measured in flat_loop.py's docstring). Episodes
+auto-reset in place so every lane stays busy (steady-state throughput).
 """
 
 from __future__ import annotations
@@ -22,7 +29,7 @@ from jax import lax
 
 from sparksched_tpu.config import EnvParams
 from sparksched_tpu.env import core
-from sparksched_tpu.env.observe import observe
+from sparksched_tpu.env.flat_loop import init_loop_state, micro_step
 from sparksched_tpu.schedulers.heuristics import round_robin_policy
 from sparksched_tpu.workload import make_workload_bank
 
@@ -33,53 +40,48 @@ NUM_ENVS = 1024
 SUB_BATCH = 512
 # the tunnel also kills device programs that run for tens of seconds, so
 # keep each timed program short and accumulate across calls
-CHUNK = 16  # decision steps per timed scan
-NUM_CHUNKS = 2
+MICRO_CHUNK = 256  # micro-steps per timed scan
+NUM_CHUNKS = 4
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 
 
 @partial(jax.jit, static_argnums=(0,))
-def bench_chunk(params: EnvParams, bank, states, rngs):
-    """CHUNK decision steps per lane; finished episodes reset in place so
-    every lane stays busy (steady-state throughput)."""
+def bench_chunk(params: EnvParams, bank, loop_states, rngs):
+    """MICRO_CHUNK flat micro-steps per lane; returns updated loop states
+    and the total decision count across the batch."""
 
-    def lane(state, rng):
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    def lane(ls, rng):
         def body(carry, _):
-            st, k, n = carry
-            k, k_reset = jax.random.split(k)
-            obs = observe(params, st)
-            stage_idx, num_exec = round_robin_policy(
-                obs, params.num_executors, True
+            ls, k = carry
+            k, sub = jax.random.split(k)
+            ls = micro_step(
+                params, bank, pol, ls, sub,
+                auto_reset=True, compute_levels=False,
             )
-            nxt, _, term, trunc = core.step(
-                params, bank, st, stage_idx, num_exec
-            )
-            done = term | trunc
-            # unconditional reset + select (a lane-dependent lax.cond would
-            # broadcast the bank across the batch; see env/core.py)
-            fresh = core.reset(params, bank, k_reset)
-            nxt = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(done, a, b), fresh, nxt
-            )
-            return (nxt, k, n + 1), None
+            return (ls, k), None
 
-        (st, _, n), _ = lax.scan(
-            body, (state, rng, jnp.int32(0)), None, length=CHUNK
+        (ls, _), _ = lax.scan(
+            body, (ls, rng), None, length=MICRO_CHUNK
         )
-        return st, n
+        return ls
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
     sub = min(SUB_BATCH, b)
     group = jax.tree_util.tree_map(
-        lambda a: a.reshape(b // sub, sub, *a.shape[1:]), (states, rngs)
+        lambda a: a.reshape(b // sub, sub, *a.shape[1:]),
+        (loop_states, rngs),
     )
-    states, counts = lax.map(
+    loop_states = lax.map(
         lambda sr: jax.vmap(lane)(sr[0], sr[1]), group
     )
-    states = jax.tree_util.tree_map(
-        lambda a: a.reshape(b, *a.shape[2:]), states
+    loop_states = jax.tree_util.tree_map(
+        lambda a: a.reshape(b, *a.shape[2:]), loop_states
     )
-    return states, counts.sum()
+    return loop_states, loop_states.decisions.sum()
 
 
 def main() -> None:
@@ -102,26 +104,27 @@ def main() -> None:
     rng = jax.random.PRNGKey(0)
     reset_keys = jax.random.split(rng, NUM_ENVS)
     states = jax.vmap(lambda k: core.reset(params, bank, k))(reset_keys)
-    step_keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
+    loop_states = jax.vmap(init_loop_state)(states)
 
     # warmup/compile
-    states, n = bench_chunk(params, bank, states, step_keys)
-    jax.block_until_ready(n)
+    keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
+    loop_states, n = bench_chunk(params, bank, loop_states, keys)
+    base = int(jax.block_until_ready(n))
 
-    total = 0
     t0 = time.perf_counter()
     for i in range(NUM_CHUNKS):
         keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
-        states, n = bench_chunk(params, bank, states, keys)
-        total += int(jax.block_until_ready(n))
+        loop_states, n = bench_chunk(params, bank, loop_states, keys)
+        total = int(jax.block_until_ready(n))
     dt = time.perf_counter() - t0
 
-    value = total / dt
+    value = (total - base) / dt
     print(
         json.dumps(
             {
                 "metric": (
-                    "env_decision_steps_per_sec_1024envs_fair_tpch"
+                    "env_decision_steps_per_sec_1024envs_fair_"
+                    "synthetic_tpch"
                 ),
                 "value": round(value, 1),
                 "unit": "steps/s",
@@ -132,4 +135,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    from sparksched_tpu.config import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     main()

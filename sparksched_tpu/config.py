@@ -83,6 +83,27 @@ def env_params_from_cfg(env_cfg: dict[str, Any]) -> EnvParams:
     return EnvParams(**kw)
 
 
+def honor_jax_platforms_env() -> None:
+    """Re-assert the user's ``JAX_PLATFORMS`` choice via jax.config.
+
+    Normally a no-op (jax reads the env var itself), but platform
+    plugins preloaded at interpreter startup can override the selection
+    before user code runs; calling this from a CLI entry point before
+    any computation restores the standard env-var semantics (e.g.
+    ``JAX_PLATFORMS=cpu python train.py ...``)."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        cur = jax.config.jax_platforms or ""
+        # leave richer selections alone when they already honor the env
+        # choice as primary (e.g. env "axon" vs plugin's "axon,cpu")
+        if not cur.startswith(plat):
+            jax.config.update("jax_platforms", plat)
+
+
 def load(filename: str | None = None) -> dict[str, Any]:
     """Load a YAML experiment config (reference cfg_loader.py:5-13)."""
     if not filename:

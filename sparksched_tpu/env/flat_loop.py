@@ -96,6 +96,7 @@ def micro_step(
     ls: LoopState,
     rng: jax.Array,
     auto_reset: bool = True,
+    compute_levels: bool = True,
 ) -> LoopState:
     """One unit of work for one lane (vmap over lanes)."""
     k_pol, k_reset = jax.random.split(rng)
@@ -105,7 +106,7 @@ def micro_step(
 
     # ---- DECIDE: one commitment from the policy (core.step's front half)
     def decide(ls: LoopState):
-        obs = observe(params, ls.env)
+        obs = observe(params, ls.env, compute_levels)
         stage_idx, num_exec, _ = policy_fn(k_pol, obs)
         st = ls.env
         j, s = stage_idx // s_cap, stage_idx % s_cap
@@ -190,7 +191,10 @@ def micro_step(
 
         st, rk, rj, rs = lax.cond(k < ls.num_idle, do, skip, st)
         last = k + 1 >= ls.num_idle
-        st = lax.cond(last, _clear_round, lambda x: x, st)
+        # round clearing is deferred to the shared tail (after this
+        # fulfillment's resolve/apply), matching core.step which clears
+        # only after _fulfill_from_source returns — the final executor's
+        # backup-stage search must still see stage_selected
         mode = jnp.where(last, M_EVENT, M_FULFILL).astype(_i32)
         return ls.replace(env=st, mode=mode, fulfill_k=k + 1), rk, rj, rs, \
             e, quirk
@@ -229,6 +233,13 @@ def micro_step(
     # shared move resolution + application (the only bank access)
     ak, tj, ts = _resolve_action(params, st, rk, e, rj, rs, quirk)
     st = _apply_action(params, bank, st, ak, e, tj, ts)
+
+    # a FULFILL micro-step that consumed the round's last idle executor
+    # clears the round here, after its resolve/apply (core.step ordering)
+    fulfill_done = (ls.mode == M_FULFILL) & (
+        ls2.fulfill_k >= ls2.num_idle
+    )
+    st = lax.cond(fulfill_done, _clear_round, lambda x: x, st)
 
     # post-event round-ready check (core._resume_simulation :tail), only
     # meaningful after EVENT micro-steps
@@ -300,6 +311,7 @@ def run_flat(
     num_micro_steps: int,
     state: EnvState,
     auto_reset: bool = True,
+    compute_levels: bool = True,
 ) -> LoopState:
     """Scan `num_micro_steps` micro-steps for one lane (vmap over lanes)."""
     ls = init_loop_state(state)
@@ -308,7 +320,11 @@ def run_flat(
         ls, k = carry
         k, sub = jax.random.split(k)
         return (
-            micro_step(params, bank, policy_fn, ls, sub, auto_reset), k
+            micro_step(
+                params, bank, policy_fn, ls, sub, auto_reset,
+                compute_levels,
+            ),
+            k,
         ), None
 
     (ls, _), _ = lax.scan(body, (ls, rng), None, length=num_micro_steps)

@@ -47,8 +47,15 @@ class Observation(struct.PyTreeNode):
         return self.node_mask.sum().astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnums=0)
-def observe(params: EnvParams, state: EnvState) -> Observation:
+@partial(jax.jit, static_argnums=(0, 2))
+def observe(
+    params: EnvParams, state: EnvState, compute_levels: bool = True
+) -> Observation:
+    """`compute_levels=False` skips the S-deep topological-generation
+    fori_loop (an [J,S,S] reduction per level — by far the most expensive
+    part of an observation) and fills `node_level` with the padding value.
+    Only the Decima GNN reads `node_level`; heuristic policies must pass
+    False on hot paths."""
     job_mask = state.job_active
     node_mask = (
         job_mask[:, None] & state.stage_exists & ~state.stage_completed
@@ -62,6 +69,12 @@ def observe(params: EnvParams, state: EnvState) -> Observation:
         axis=-1,
     )
     nodes = jnp.where(node_mask[:, :, None], nodes, 0.0)
+    if compute_levels:
+        node_level = _core.compute_node_levels(params, state)
+    else:
+        node_level = jnp.full(
+            node_mask.shape, node_mask.shape[1], jnp.int32
+        )
     return Observation(
         nodes=nodes,
         node_mask=node_mask,
@@ -69,7 +82,7 @@ def observe(params: EnvParams, state: EnvState) -> Observation:
         schedulable=state.schedulable & node_mask,
         frontier=state.frontier & node_mask,
         adj=state.adj,
-        node_level=_core.compute_node_levels(params, state),
+        node_level=node_level,
         exec_supplies=jnp.where(job_mask, state.job_supply, 0),
         num_committable=state.num_committable(),
         source_job=state.source_job_id(),

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from ..config import EnvParams
@@ -37,8 +38,11 @@ from ..config import EnvParams
 # (spark_sched_sim.py:68-72)
 EV_JOB_ARRIVAL, EV_TASK_FINISHED, EV_EXECUTOR_READY = 0, 1, 2
 
-INF = jnp.float32(jnp.inf)
-BIG_SEQ = jnp.int32(2**30)
+# numpy scalars, not jnp: creating a jax array at import time would
+# initialize the backend (and claim the TPU) on `import sparksched_tpu`;
+# numpy dtypes carry through jnp ops identically
+INF = np.float32(np.inf)
+BIG_SEQ = np.int32(2**30)
 
 
 class EnvState(struct.PyTreeNode):

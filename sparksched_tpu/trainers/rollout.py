@@ -115,6 +115,9 @@ class Rollout(struct.PyTreeNode):
     valid: jnp.ndarray  # bool[T]; step actually happened
     resets: jnp.ndarray  # bool[T]; async: env was reset after this step
     final_state: EnvState
+    # async: the next reset ordinal for this lane (drives the group-shared
+    # job-sequence key; reference rollout_worker.py:119-120). 0 for sync.
+    final_reset_count: jnp.ndarray  # i32 []
 
     @property
     def num_steps(self) -> jnp.ndarray:
@@ -127,9 +130,14 @@ class Rollout(struct.PyTreeNode):
 PolicyFn = Callable[[jax.Array, Observation], tuple]
 
 
-def _aux_fields(aux: dict, stage_idx: jnp.ndarray, num_exec: jnp.ndarray):
+def _aux_fields(aux: dict, stage_idx: jnp.ndarray, num_exec: jnp.ndarray,
+                max_stages: int):
     lgprob = aux.get("lgprob", jnp.float32(0.0))
-    job = aux.get("job_idx", jnp.where(stage_idx >= 0, stage_idx, 0))
+    # heuristic policies don't report job_idx; derive it from the flat
+    # padded node index (stage_idx = job * max_stages + stage)
+    job = aux.get(
+        "job_idx", jnp.where(stage_idx >= 0, stage_idx // max_stages, 0)
+    )
     k = aux.get("num_exec_k", num_exec - 1)
     return lgprob, job, k
 
@@ -156,7 +164,9 @@ def collect_sync(
         nxt = jax.tree_util.tree_map(
             lambda a, b: jnp.where(done, a, b), st, nxt
         )
-        lgprob, job, kk = _aux_fields(aux, stage_idx, num_exec)
+        lgprob, job, kk = _aux_fields(
+            aux, stage_idx, num_exec, params.max_stages
+        )
         rec = (
             store_obs(obs, st),
             jnp.where(done, -1, stage_idx),
@@ -184,6 +194,7 @@ def collect_sync(
         valid=valid,
         resets=jnp.zeros_like(valid),
         final_state=final,
+        final_reset_count=jnp.int32(0),
     )
 
 
@@ -196,16 +207,33 @@ def collect_async(
     num_steps: int,
     state: EnvState,
     rollout_duration: jnp.ndarray | float = jnp.inf,
+    seq_base: jax.Array | None = None,
+    lane_salt: jnp.ndarray | int = 0,
+    reset_count: jnp.ndarray | int = 0,
 ) -> Rollout:
     """Fixed sim-time budget with persistent envs and auto-reset (reference
     RolloutWorkerAsync.collect_rollout:171-206). `wall_times` are *elapsed*
     times within the iteration, continuing across resets. Steps after the
-    budget is exhausted are masked."""
+    budget is exhausted are masked.
+
+    Mid-scan resets draw the new episode from
+    ``fold_in(seq_base, reset_count)`` — so lanes that share `seq_base`
+    (a sequence group) replay identical job-arrival sequences at equal
+    reset ordinals, which the grouped critic-free baseline relies on
+    (reference ``base_seed + seed_step * reset_count``,
+    rollout_worker.py:119-120, trainer.py:268-271). `lane_salt`
+    de-correlates the per-lane stochastic stream within a group
+    (core.reset_pair's seq/lane split). When `seq_base` is None (ad-hoc
+    use outside a trainer), `rng` stands in for it."""
     rollout_duration = jnp.float32(rollout_duration)
+    if seq_base is None:
+        seq_base = rng
+    lane_salt = jnp.asarray(lane_salt, _i32)
+    reset_count = jnp.asarray(reset_count, _i32)
 
     def body(carry, _):
-        st, k, elapsed = carry
-        k, k_pol, k_reset = jax.random.split(k, 3)
+        st, k, elapsed, rc = carry
+        k, k_pol = jax.random.split(k)
         obs = observe(params, st)
         over = elapsed >= rollout_duration
         stage_idx, num_exec, aux = policy_fn(k_pol, obs)
@@ -218,16 +246,23 @@ def collect_async(
         # unconditional reset + tree-select rather than lax.cond: a
         # lane-dependent cond broadcasts the closed-over workload bank
         # across the vmap batch (see env/core.py structural note)
-        fresh = core.reset(params, bank, k_reset)
+        seq_rng = jax.random.fold_in(seq_base, rc)
+        fresh = core.reset_pair(
+            params, bank, seq_rng, jax.random.fold_in(seq_rng, lane_salt)
+        )
+        did_reset = done & ~over
         nxt2 = jax.tree_util.tree_map(
-            lambda a, b: jnp.where(done & ~over, a, b), fresh, nxt
+            lambda a, b: jnp.where(did_reset, a, b), fresh, nxt
         )
         # budget exhausted: freeze the lane
         nxt2 = jax.tree_util.tree_map(
             lambda a, b: jnp.where(over, a, b), st, nxt2
         )
         new_elapsed = jnp.where(over, elapsed, new_elapsed)
-        lgprob, job, kk = _aux_fields(aux, stage_idx, num_exec)
+        new_rc = rc + did_reset.astype(_i32)
+        lgprob, job, kk = _aux_fields(
+            aux, stage_idx, num_exec, params.max_stages
+        )
         rec = (
             store_obs(obs, st),
             jnp.where(over, -1, stage_idx),
@@ -237,14 +272,15 @@ def collect_async(
             jnp.where(over, 0.0, reward),
             elapsed,
             ~over,
-            done & ~over,
+            did_reset,
         )
-        return (nxt2, k, new_elapsed), rec
+        return (nxt2, k, new_elapsed, new_rc), rec
 
-    (final, _, elapsed), (
+    (final, _, elapsed, final_rc), (
         obs, stage_idx, job, kk, lgprob, reward, wt, valid, resets
     ) = lax.scan(
-        body, (state, rng, jnp.float32(0.0)), None, length=num_steps
+        body, (state, rng, jnp.float32(0.0), reset_count), None,
+        length=num_steps,
     )
     wall_times = jnp.concatenate([wt, elapsed[None]])
     return Rollout(
@@ -258,6 +294,7 @@ def collect_async(
         valid=valid,
         resets=resets,
         final_state=final,
+        final_reset_count=final_rc,
     )
 
 

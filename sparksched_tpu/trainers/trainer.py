@@ -201,16 +201,27 @@ class Trainer(abc.ABC):
 
         if self.rollout_duration:  # async mode
             if env_states is None:
-                env_states = jax.vmap(
+                states = jax.vmap(
                     lambda s, l: core.reset_pair(p, bank, s, l)
                 )(seq_rngs, lane_rngs)
-            ro = jax.vmap(
-                lambda k, s: collect_async(
-                    p, bank, policy_fn, k, self.rollout_steps, s,
-                    self.rollout_duration,
+                # the initial reset consumed ordinal `iteration`; the
+                # next (mid-scan) reset of any lane is ordinal + 1
+                reset_counts = jnp.full(
+                    (G * R,), iteration + 1, jnp.int32
                 )
-            )(pol_rngs, env_states)
-            return ro, ro.final_state
+            else:
+                states, reset_counts = env_states
+            seq_bases = jax.vmap(
+                lambda g: jax.random.fold_in(master, g)
+            )(g_ids)
+            lane_salts = (1000 + r_ids).astype(jnp.int32)
+            ro = jax.vmap(
+                lambda k, s, sb, salt, rc: collect_async(
+                    p, bank, policy_fn, k, self.rollout_steps, s,
+                    self.rollout_duration, sb, salt, rc,
+                )
+            )(pol_rngs, states, seq_bases, lane_salts, reset_counts)
+            return ro, (ro.final_state, ro.final_reset_count)
         else:  # sync: fresh episode per iteration
             states = jax.vmap(
                 lambda s, l: core.reset_pair(p, bank, s, l)

@@ -288,3 +288,90 @@ def test_vpg_async_differential(tmp_path):
         )
     )
     assert changed
+
+
+# ---------------------------------------------------------------------------
+# async rollouts: group-shared job sequences across mid-scan resets
+# (ADVICE r1: reset keys must derive from the group seq key + reset
+# ordinal, not the per-lane policy rng chain)
+# ---------------------------------------------------------------------------
+
+
+def test_collect_async_group_shares_sequences_across_resets():
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+    from sparksched_tpu.trainers.rollout import collect_async
+    from sparksched_tpu.workload import make_workload_bank
+
+    params = EnvParams(
+        num_executors=4, max_jobs=3, max_stages=20, max_levels=20,
+        moving_delay=500.0, warmup_delay=200.0,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    master = jax.random.PRNGKey(7)
+    seq_base = jax.random.fold_in(master, 0)  # one sequence group
+    seq0 = jax.random.fold_in(seq_base, 0)  # initial reset ordinal 0
+
+    T = 400
+    ros = []
+    for r in range(2):  # two lanes of the same group
+        lane_salt = 1000 + r
+        state = core.reset_pair(
+            params, bank, seq0, jax.random.fold_in(seq0, lane_salt)
+        )
+        ro = collect_async(
+            params, bank, pol,
+            jax.random.fold_in(master, 100 + r),  # distinct policy chains
+            T, state, 1e9, seq_base, lane_salt, 1,
+        )
+        ros.append(ro)
+
+    # every lane must have auto-reset at least twice for the test to bite
+    n_resets = [int(ro.resets.sum()) for ro in ros]
+    assert min(n_resets) >= 2, n_resets
+
+    # for equal reset ordinals the job sequence (template ids + arrival
+    # count) must be identical across the group, even though the resets
+    # happen at different scan steps in each lane
+    for ordinal in range(2):
+        tmpl = []
+        for ro in ros:
+            step_after = int(np.flatnonzero(np.asarray(ro.resets))[ordinal]) + 1
+            assert step_after < T
+            tmpl.append(np.asarray(ro.obs.job_template[step_after]))
+        np.testing.assert_array_equal(tmpl[0], tmpl[1])
+
+    # different groups draw different sequences at the same ordinal
+    other_base = jax.random.fold_in(master, 1)
+    oseq0 = jax.random.fold_in(other_base, 0)
+    ostate = core.reset_pair(
+        params, bank, oseq0, jax.random.fold_in(oseq0, 1000)
+    )
+    oro = collect_async(
+        params, bank, pol, jax.random.fold_in(master, 200),
+        T, ostate, 1e9, other_base, 1000, 1,
+    )
+    step_after = int(np.flatnonzero(np.asarray(oro.resets))[0]) + 1
+    same = np.array_equal(
+        np.asarray(oro.obs.job_template[step_after]),
+        np.asarray(ros[0].obs.job_template[
+            int(np.flatnonzero(np.asarray(ros[0].resets))[0]) + 1
+        ]),
+    )
+    same_arrivals = np.array_equal(
+        np.asarray(oro.final_state.job_arrival_time),
+        np.asarray(ros[0].final_state.job_arrival_time),
+    )
+    assert not (same and same_arrivals)
