@@ -72,9 +72,19 @@ class EnvParams:
 
 
 def env_params_from_cfg(env_cfg: dict[str, Any]) -> EnvParams:
-    """Build EnvParams from a reference-style `env:` config section."""
-    known = {f.name for f in dataclasses.fields(EnvParams)}
-    kw = {k: v for k, v in env_cfg.items() if k in known}
+    """Build EnvParams from a reference-style `env:` config section.
+
+    Field values are coerced to the declared int/float types: PyYAML 1.1
+    parses exponent literals without a sign (``2.0e7``) as *strings*, and
+    a string smuggled into a jitted computation fails deep inside XLA."""
+    types = {f.name: f.type for f in dataclasses.fields(EnvParams)}
+    kw: dict[str, Any] = {}
+    for k, v in env_cfg.items():
+        if k not in types:
+            continue
+        if v is not None:
+            v = int(float(v)) if types[k] == "int" else float(v)
+        kw[k] = v
     if "max_jobs" not in kw and "job_arrival_cap" in env_cfg:
         kw["max_jobs"] = int(env_cfg["job_arrival_cap"])
     if "mean_time_limit" in env_cfg and "job_arrival_cap" not in env_cfg:

@@ -38,10 +38,18 @@ def num_job_arrivals(state: EnvState) -> jnp.ndarray:
     return state.job_arrived.sum()
 
 
-def job_duration_percentiles(state: EnvState, qs=(25, 50, 75, 100)):
-    """Percentiles over arrived jobs (reference metrics.py:21-23). Computed
-    host-side on the masked durations."""
+PERCENTILE_QS = (25, 50, 75, 100)
+
+
+def masked_percentiles(durations, mask, qs=PERCENTILE_QS):
+    """Host-side percentiles over masked durations; one shared policy for
+    single states and pooled vmapped batches."""
     import numpy as np
 
-    d, m = map(np.asarray, job_durations(state))
+    d, m = np.asarray(durations).ravel(), np.asarray(mask).ravel()
     return np.percentile(d[m], list(qs)) if m.any() else np.zeros(len(qs))
+
+
+def job_duration_percentiles(state: EnvState, qs=PERCENTILE_QS):
+    """Percentiles over arrived jobs (reference metrics.py:21-23)."""
+    return masked_percentiles(*job_durations(state), qs)

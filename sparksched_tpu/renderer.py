@@ -62,7 +62,7 @@ class GanttRenderer:
         segs = self._segments()
         state = self.final_state
         n_jobs = int(np.asarray(state.job_arrived).sum()) if state else 1
-        cmap = plt.cm.get_cmap("tab20", max(n_jobs, 1))
+        cmap = plt.colormaps["tab20"].resampled(max(n_jobs, 1))
 
         fig, ax = plt.subplots(
             figsize=(12, 0.4 * self.num_executors + 2)

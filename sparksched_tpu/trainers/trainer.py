@@ -62,7 +62,7 @@ def make_optimizer(train_cfg: CfgType) -> optax.GradientTransformation:
     decima_tpch.yaml:60-63)."""
     opt_cls = train_cfg.get("opt_cls", "Adam").lower()
     kwargs = dict(train_cfg.get("opt_kwargs") or {})
-    lr = kwargs.pop("lr", 3e-4)
+    lr = float(kwargs.pop("lr", 3e-4))
     makers = {
         "adam": optax.adam,
         "adamw": optax.adamw,
@@ -94,14 +94,18 @@ class Trainer(abc.ABC):
         self.checkpointing_freq: int = train_cfg.get(
             "checkpointing_freq", 50
         )
-        self.rollout_duration = train_cfg.get("rollout_duration")
+        rd = train_cfg.get("rollout_duration")
+        # YAML exponent literals without a sign ("2.0e7") arrive as strings
+        self.rollout_duration = float(rd) if rd is not None else None
 
         # exactly one returns mode (reference trainer.py:63-74)
         assert ("reward_buff_cap" in train_cfg) ^ (
             "beta_discount" in train_cfg
         ), "provide exactly one of reward_buff_cap / beta_discount"
-        self.beta: float = train_cfg.get("beta_discount", 0.0)
-        self.reward_buff_cap: int = train_cfg.get("reward_buff_cap", 0)
+        self.beta: float = float(train_cfg.get("beta_discount", 0.0))
+        self.reward_buff_cap: int = int(
+            train_cfg.get("reward_buff_cap", 0)
+        )
         if self.beta:
             env_cfg = env_cfg | {"beta": self.beta}
 
@@ -326,7 +330,13 @@ class Trainer(abc.ABC):
 
     def _rollout_stats(self, ro: Rollout) -> dict[str, float]:
         fs = ro.final_state
-        return {
+        d, m = jax.vmap(metrics.job_durations)(fs)
+        pcts = metrics.masked_percentiles(d, m)  # pooled across lanes
+        pct_stats = {
+            f"job_duration_p{q}": float(v)
+            for q, v in zip(metrics.PERCENTILE_QS, pcts)
+        }
+        return pct_stats | {
             "avg_job_duration": float(
                 jax.vmap(metrics.avg_job_duration)(fs).mean()
             ),

@@ -1,0 +1,142 @@
+"""Mesh-path sharding assertions (VERDICT r1 #7).
+
+The dp-mesh path replaces the reference's multi-process rollout fan-out +
+pipe scatter/gather (/root/reference/trainers/trainer.py:110-121,264-296).
+These tests assert it is *really* distributed, not accidentally
+replicated: rollout lanes land sharded across devices, the jitted update
+contains cross-device collectives, and mesh-vs-no-mesh training computes
+identical parameters (same seeds -> same program, different layout).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparksched_tpu.parallel import (
+    DP_AXIS,
+    lane_sharding,
+    make_mesh,
+    shard_lanes,
+)
+
+
+def _tiny_cfg(num_rollouts: int):
+    return (
+        {
+            "agent_cls": "DecimaScheduler",
+            "embed_dim": 8,
+            "gnn_mlp_kwargs": {
+                "hid_dims": [16, 8],
+                "act_cls": "LeakyReLU",
+                "act_kwargs": {"negative_slope": 0.2},
+            },
+            "policy_mlp_kwargs": {"hid_dims": [16, 16], "act_cls": "Tanh"},
+        },
+        {
+            "num_executors": 4,
+            "job_arrival_cap": 3,
+            "moving_delay": 2000.0,
+            "job_arrival_rate": 4.0e-5,
+            "warmup_delay": 1000.0,
+        },
+        {
+            "trainer_cls": "PPO",
+            "num_iterations": 1,
+            "num_sequences": 1,
+            "num_rollouts": num_rollouts,
+            "seed": 0,
+            "use_tensorboard": False,
+            "num_epochs": 1,
+            "num_batches": 2,
+            "beta_discount": 5.0e-3,
+            "opt_kwargs": {"lr": 3.0e-4},
+            "max_grad_norm": 0.5,
+            "rollout_steps": 12,
+        },
+    )
+
+
+def _make_trainer(num_rollouts: int, mesh=None):
+    from sparksched_tpu.trainers.ppo import PPO
+
+    agent, env, tr = _tiny_cfg(num_rollouts)
+    return PPO(agent, env, tr, mesh=mesh)
+
+
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_rollout_lanes_shard_across_devices(n_dev):
+    assert len(jax.devices()) >= n_dev
+    mesh = make_mesh(n_dev)
+    trainer = _make_trainer(num_rollouts=n_dev)
+    state = trainer.init_state()
+
+    ro, _ = jax.jit(
+        trainer._collect, out_shardings=(lane_sharding(mesh), None)
+    )(state.params, state.iteration, state.rng, None)
+
+    leaf = ro.reward  # [B, T]
+    assert leaf.shape[0] == n_dev
+    shards = leaf.addressable_shards
+    assert len(shards) == n_dev
+    # one lane per device, placed on distinct devices
+    assert {s.data.shape[0] for s in shards} == {1}
+    assert len({s.device.id for s in shards}) == n_dev
+    # every leaf with a lane axis carries the dp sharding
+    spec = leaf.sharding.spec
+    assert spec[0] == DP_AXIS
+
+
+def test_update_jaxpr_contains_cross_device_collectives():
+    n_dev = 4
+    mesh = make_mesh(n_dev)
+    trainer = _make_trainer(num_rollouts=n_dev, mesh=mesh)
+    state = trainer.init_state()
+    ro, _ = trainer._collect_jit(
+        state.params, state.iteration, state.rng, None
+    )
+    ro = shard_lanes(ro, mesh)
+
+    lowered = trainer._update_jit.lower(state, ro)
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
+    assert ("all-reduce" in hlo) or ("all-gather" in hlo), (
+        "update program contains no cross-device collectives"
+    )
+
+
+def test_mesh_and_single_device_updates_agree():
+    n_dev = 4
+    mesh = make_mesh(n_dev)
+
+    results = {}
+    for name, m in (("mesh", mesh), ("single", None)):
+        trainer = _make_trainer(num_rollouts=n_dev, mesh=m)
+        state = trainer.init_state()
+        ro, _ = trainer._collect_jit(
+            state.params, state.iteration, state.rng, None
+        )
+        if m is not None:
+            ro = shard_lanes(ro, mesh)
+        state, _ = trainer._update_jit(state, ro)
+        results[name] = jax.device_get(state.params)
+
+    flat_a = jax.tree_util.tree_leaves(results["mesh"])
+    flat_b = jax.tree_util.tree_leaves(results["single"])
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_shard_lanes_places_every_leaf():
+    mesh = make_mesh(8)
+    tree = {
+        "a": jnp.zeros((16, 3)),
+        "b": jnp.ones((16,), jnp.int32),
+    }
+    out = shard_lanes(tree, mesh)
+    for leaf in jax.tree_util.tree_leaves(out):
+        assert len(leaf.addressable_shards) == 8
+        assert leaf.sharding.spec[0] == DP_AXIS

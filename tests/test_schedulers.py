@@ -443,3 +443,243 @@ def test_sample_evaluate_consistency():
             action.num_exec + 1,
         )
     assert n_checked >= 5
+
+
+# ---------------------------------------------------------------------------
+# flax-vs-torch numeric forward parity with the real pretrained checkpoint
+# (VERDICT r1 #9). The reference forward (scheduler.py:191-234,244-276,
+# 292-319,337-376) is replicated here in plain torch (PyG-free) and driven
+# by the actual model.pt weights; the flax model with the converted weights
+# must produce the same stage/exec scores to ~1e-5.
+# ---------------------------------------------------------------------------
+
+
+def _torch_mlp(sd, prefix, v, act):
+    idxs = sorted(
+        {
+            int(k[len(prefix) + 1:].split(".")[0])
+            for k in sd
+            if k.startswith(prefix + ".")
+        }
+    )
+    for i, si in enumerate(idxs):
+        v = v @ sd[f"{prefix}.{si}.weight"].T + sd[f"{prefix}.{si}.bias"]
+        if i < len(idxs) - 1:
+            v = act(v)
+    return v
+
+
+def _torch_reference_forward(
+    sd, x, edge_index, ptr, edge_masks, stage_mask, exec_mask, job_idx,
+    num_executors,
+):
+    """Reference DecimaScheduler forward, single-obs path, with plain torch
+    tensors in place of PyG/torch_sparse/torch_scatter."""
+    import torch
+
+    def leaky(v):
+        return torch.nn.functional.leaky_relu(v, 0.2)
+
+    tanh = torch.tanh
+    n = x.shape[0]
+
+    # NodeEncoder (reference scheduler.py:189-234; reverse_flow: j,i = 1,0)
+    h_init = _torch_mlp(sd, "encoder.node_encoder.mlp_prep", x, leaky)
+    h = torch.zeros_like(h_init)
+    no_children = torch.ones(n, dtype=torch.bool)
+    no_children[edge_index[0]] = False
+    h[no_children] = _torch_mlp(
+        sd, "encoder.node_encoder.mlp_update", h_init[no_children], leaky
+    )
+    for em in reversed(edge_masks):
+        ei = edge_index[:, torch.as_tensor(em)]
+        src = torch.zeros(n, dtype=torch.bool)
+        src[ei[1]] = True
+        dst = torch.zeros(n, dtype=torch.bool)
+        dst[ei[0]] = True
+        msg = torch.zeros_like(h)
+        msg[src] = _torch_mlp(
+            sd, "encoder.node_encoder.mlp_msg", h[src], leaky
+        )
+        adj = torch.zeros((n, n), dtype=x.dtype)
+        adj[ei[0], ei[1]] = 1.0
+        agg = adj @ msg
+        h[dst] = h_init[dst] + _torch_mlp(
+            sd, "encoder.node_encoder.mlp_update", agg[dst], leaky
+        )
+    h_node = h
+
+    # DagEncoder (scheduler.py:252-257): segment-sum of mlp([x || h])
+    z = _torch_mlp(
+        sd, "encoder.dag_encoder.mlp", torch.cat([x, h_node], 1), leaky
+    )
+    h_dag = torch.stack(
+        [z[ptr[i]:ptr[i + 1]].sum(0) for i in range(len(ptr) - 1)]
+    )
+
+    # GlobalEncoder (scheduler.py:265-276), single obs: sum over dags
+    h_glob = _torch_mlp(
+        sd, "encoder.global_encoder.mlp", h_dag, leaky
+    ).sum(0, keepdim=True)
+
+    # StagePolicyNetwork (scheduler.py:292-319)
+    batch = torch.repeat_interleave(
+        torch.arange(len(ptr) - 1), ptr[1:] - ptr[:-1]
+    )
+    sm = torch.as_tensor(stage_mask)
+    stage_in = torch.cat(
+        [
+            x[sm],
+            h_node[sm],
+            h_dag[batch[sm]],
+            h_glob.repeat(int(sm.sum()), 1),
+        ],
+        dim=1,
+    )
+    node_scores = _torch_mlp(
+        sd, "stage_policy_network.mlp_score", stage_in, tanh
+    ).squeeze(-1)
+
+    # ExecPolicyNetwork (scheduler.py:337-376,368-376), single obs
+    em_j = torch.as_tensor(exec_mask[job_idx])
+    x_dag = x[ptr[job_idx], :3].unsqueeze(0)
+    ks = (torch.arange(num_executors) / num_executors)[em_j].unsqueeze(1)
+    rep = torch.cat([x_dag, h_dag[job_idx].unsqueeze(0)], 1).repeat(
+        ks.shape[0], 1
+    )
+    exec_in = torch.cat(
+        [rep, h_glob.repeat(ks.shape[0], 1), ks.to(x.dtype)], dim=1
+    )
+    dag_scores = _torch_mlp(
+        sd, "exec_policy_network.mlp_score", exec_in, tanh
+    ).squeeze(-1)
+    return node_scores, dag_scores
+
+
+def _dag_layer_edge_masks(edge_links: np.ndarray, num_nodes: int):
+    """Reference make_dag_layer_edge_masks (decima/utils.py:238-267)."""
+    import networkx as nx
+
+    G = nx.DiGraph()
+    G.add_nodes_from(range(num_nodes))
+    G.add_edges_from(edge_links)
+    node_levels = list(nx.topological_generations(G))
+    if len(node_levels) <= 1:
+        return np.zeros((0, edge_links.shape[0]), dtype=bool)
+    masks = []
+    node_mask = np.zeros(num_nodes, dtype=bool)
+    for level in node_levels[:-1]:
+        succ = set.union(*[set(G.successors(u)) for u in level])
+        node_mask[:] = False
+        node_mask[list(level) + list(succ)] = True
+        masks.append(
+            node_mask[edge_links[:, 0]] & node_mask[edge_links[:, 1]]
+        )
+    return np.stack(masks)
+
+
+@pytest.mark.skipif(not reference_available(), reason="no reference mounted")
+def test_decima_forward_matches_reference_torch_checkpoint():
+    import jax.numpy as jnp
+    import torch
+
+    from sparksched_tpu.schedulers import DecimaScheduler
+    from sparksched_tpu.schedulers.decima import DecimaFeatures
+
+    num_executors = 50
+    sched = DecimaScheduler(
+        num_executors=num_executors,
+        embed_dim=16,
+        gnn_mlp_kwargs={
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+        state_dict_path="/root/reference/models/decima/model.pt",
+    )
+    sd = torch.load(
+        "/root/reference/models/decima/model.pt",
+        map_location="cpu",
+        weights_only=True,
+    )
+
+    # fixture: diamond (4 stages) + chain (3) + singleton (1) + padded job
+    j_cap, s_cap = 4, 5
+    jobs = [
+        {"edges": [(0, 1), (0, 2), (1, 3), (2, 3)], "n": 4,
+         "levels": [0, 1, 1, 2]},
+        {"edges": [(0, 1), (1, 2)], "n": 3, "levels": [0, 1, 2]},
+        {"edges": [], "n": 1, "levels": [0]},
+    ]
+    rng = np.random.default_rng(11)
+
+    x_pad = np.zeros((j_cap, s_cap, 5), np.float32)
+    node_mask = np.zeros((j_cap, s_cap), bool)
+    stage_mask_pad = np.zeros((j_cap, s_cap), bool)
+    adj_pad = np.zeros((j_cap, s_cap, s_cap), bool)
+    levels_pad = np.full((j_cap, s_cap), s_cap, np.int32)
+    caps = [3, 50, 2]
+
+    flat_x, edge_links, ptr = [], [], [0]
+    stage_mask_flat, exec_mask_ref = [], []
+    for j, job in enumerate(jobs):
+        nj = job["n"]
+        xj = rng.normal(size=(nj, 5)).astype(np.float32) * 0.3
+        xj[:, :3] = rng.normal(size=3).astype(np.float32) * 0.3  # per-job
+        x_pad[j, :nj] = xj
+        node_mask[j, :nj] = True
+        levels_pad[j, :nj] = job["levels"]
+        smj = np.zeros(nj, bool)
+        smj[: max(1, nj // 2)] = True
+        stage_mask_pad[j, :nj] = smj
+        for p, c in job["edges"]:
+            adj_pad[j, p, c] = True
+            edge_links.append((ptr[-1] + p, ptr[-1] + c))
+        flat_x.append(xj)
+        stage_mask_flat.append(smj)
+        em = np.zeros(num_executors, bool)
+        em[: caps[j]] = True
+        exec_mask_ref.append(em)
+        ptr.append(ptr[-1] + nj)
+
+    feats = DecimaFeatures(
+        x=jnp.asarray(x_pad),
+        node_mask=jnp.asarray(node_mask),
+        job_mask=jnp.asarray(node_mask.any(-1)),
+        stage_mask=jnp.asarray(stage_mask_pad),
+        exec_mask=jnp.asarray(
+            np.stack(exec_mask_ref + [np.zeros(num_executors, bool)])
+        ),
+        adj=jnp.asarray(adj_pad),
+        node_level=jnp.asarray(levels_pad),
+    )
+    stage_scores, exec_scores = sched.net.apply(sched.params, feats)
+
+    edge_links = np.asarray(edge_links)
+    x_flat = torch.from_numpy(np.concatenate(flat_x))
+    edge_index = torch.from_numpy(edge_links.T.copy())
+    ptr_t = torch.as_tensor(ptr)
+    edge_masks = _dag_layer_edge_masks(edge_links, ptr[-1])
+    sm_flat = np.concatenate(stage_mask_flat)
+
+    for job_idx in range(3):
+        ref_nodes, ref_execs = _torch_reference_forward(
+            sd, x_flat, edge_index, ptr_t, edge_masks, sm_flat,
+            np.stack(exec_mask_ref), job_idx, num_executors,
+        )
+        ours_exec = np.asarray(exec_scores[job_idx])[
+            exec_mask_ref[job_idx]
+        ]
+        np.testing.assert_allclose(
+            ours_exec, ref_execs.numpy(), rtol=1e-5, atol=1e-5,
+            err_msg=f"exec scores diverge for job {job_idx}",
+        )
+
+    ours_stage = np.asarray(stage_scores)[
+        np.asarray(feats.stage_mask) & node_mask
+    ]
+    np.testing.assert_allclose(
+        ours_stage, ref_nodes.numpy(), rtol=1e-5, atol=1e-5,
+        err_msg="stage scores diverge",
+    )
