@@ -13,8 +13,13 @@ BASELINE.json.
 Engine: the flat micro-step loop (env/flat_loop.py) — every lane advances
 by one unit of work (decide / fulfill / event) per iteration, so no lane
 pays the batch-max event count of the per-decision `core.step` while_loop
-(the ~6x straggler tax measured in flat_loop.py's docstring). Episodes
-auto-reset in place so every lane stays busy (steady-state throughput).
+(the ~6x straggler tax measured in flat_loop.py's docstring). Each scan
+group is one full micro-step plus `BURST - 1` event-only sub-steps
+(`event_micro_step`): >90% of steady-state micro-steps are events, so the
+policy/observe/argsort cost of the DECIDE branch — which a batched
+`lax.switch` pays on every lane regardless of mode — is amortized BURST x.
+Episodes auto-reset in place so every lane stays busy (steady-state
+throughput).
 """
 
 from __future__ import annotations
@@ -29,7 +34,7 @@ from jax import lax
 
 from sparksched_tpu.config import EnvParams
 from sparksched_tpu.env import core
-from sparksched_tpu.env.flat_loop import init_loop_state, micro_step
+from sparksched_tpu.env.flat_loop import init_loop_state, run_flat
 from sparksched_tpu.schedulers.heuristics import round_robin_policy
 from sparksched_tpu.workload import make_workload_bank
 
@@ -40,7 +45,8 @@ NUM_ENVS = 1024
 SUB_BATCH = 512
 # the tunnel also kills device programs that run for tens of seconds, so
 # keep each timed program short and accumulate across calls
-MICRO_CHUNK = 256  # micro-steps per timed scan
+BURST = 8  # event-only sub-steps per full micro-step (incl. the full one)
+MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
 NUM_CHUNKS = 4
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 
@@ -55,19 +61,10 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs):
         return si, ne, {}
 
     def lane(ls, rng):
-        def body(carry, _):
-            ls, k = carry
-            k, sub = jax.random.split(k)
-            ls = micro_step(
-                params, bank, pol, ls, sub,
-                auto_reset=True, compute_levels=False,
-            )
-            return (ls, k), None
-
-        (ls, _), _ = lax.scan(
-            body, (ls, rng), None, length=MICRO_CHUNK
+        return run_flat(
+            params, bank, pol, rng, MICRO_CHUNK // BURST,
+            compute_levels=False, event_burst=BURST, loop_state=ls,
         )
-        return ls
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
     sub = min(SUB_BATCH, b)

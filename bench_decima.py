@@ -1,0 +1,155 @@
+"""Decima-policy benchmarks (BASELINE.md configs #3/#4).
+
+Prints one JSON line per configuration:
+
+  {"metric": "decima_infer_steps_per_sec_64envs", ...}
+  {"metric": "ppo_train_steps_per_sec_1024envs", ...}
+
+Unlike bench.py (the driver's single headline metric), this script
+records the Decima-path numbers VERDICT r1 flagged as missing: policy
+inference throughput in the rollout loop, and end-to-end PPO training
+throughput (collect + update) per decision step.
+
+Reference anchors: examples.py:64-81 (Decima episode), trainers
+rollout/PPO pipeline (trainer.py:85-162); neither publishes numbers
+(BASELINE.md) — vs_baseline is against the 50k steps/s north-star.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+from sparksched_tpu.config import EnvParams
+from sparksched_tpu.env import core
+from sparksched_tpu.schedulers import DecimaScheduler
+from sparksched_tpu.trainers.ppo import PPO
+from sparksched_tpu.trainers.rollout import collect_sync
+from sparksched_tpu.workload import make_workload_bank
+
+TARGET = 50_000.0
+
+
+def bench_inference(num_envs: int = 64, steps: int = 512) -> None:
+    params = EnvParams(
+        num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
+        moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
+        mean_time_limit=None,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    if bank.max_stages != params.max_stages:
+        params = params.replace(
+            max_stages=bank.max_stages, max_levels=bank.max_stages
+        )
+    sched = DecimaScheduler(
+        num_executors=params.num_executors,
+        embed_dim=16,
+        gnn_mlp_kwargs={
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+    )
+
+    def pol(rng, obs):
+        return sched.policy(rng, obs, sched.params)
+
+    @jax.jit
+    def run(states, rngs):
+        return jax.vmap(
+            lambda r, s: collect_sync(params, bank, pol, r, steps, s)
+        )(rngs, states)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), num_envs)
+    states = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
+    ro = run(states, jax.random.split(jax.random.PRNGKey(1), num_envs))
+    jax.block_until_ready(ro.reward)  # compile + warm
+
+    t0 = time.perf_counter()
+    n_timed = 2
+    total = 0
+    for i in range(n_timed):
+        ro = run(states, jax.random.split(jax.random.PRNGKey(2 + i),
+                                          num_envs))
+        total += int(jax.block_until_ready(ro.valid).sum())
+    dt = time.perf_counter() - t0
+    value = total / dt
+    print(json.dumps({
+        "metric": f"decima_infer_steps_per_sec_{num_envs}envs",
+        "value": round(value, 1),
+        "unit": "steps/s",
+        "vs_baseline": round(value / TARGET, 3),
+    }), flush=True)
+
+
+def bench_ppo(num_envs: int = 1024, rollout_steps: int = 256) -> None:
+    cfg_agent = {
+        "agent_cls": "DecimaScheduler",
+        "embed_dim": 16,
+        "gnn_mlp_kwargs": {
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        "policy_mlp_kwargs": {"hid_dims": [64, 64], "act_cls": "Tanh"},
+    }
+    cfg_env = {
+        "num_executors": 10,
+        "job_arrival_cap": 50,
+        "moving_delay": 2000.0,
+        "job_arrival_rate": 4.0e-5,
+        "warmup_delay": 1000.0,
+    }
+    cfg_train = {
+        "trainer_cls": "PPO",
+        "num_iterations": 1,
+        "num_sequences": 16,
+        "num_rollouts": num_envs // 16,
+        "seed": 0,
+        "use_tensorboard": False,
+        "num_epochs": 3,
+        "num_batches": 8,
+        "beta_discount": 5.0e-3,
+        "opt_kwargs": {"lr": 3.0e-4},
+        "max_grad_norm": 0.5,
+        "rollout_steps": rollout_steps,
+    }
+    trainer = PPO(cfg_agent, cfg_env, cfg_train)
+    state = trainer.init_state()
+
+    def one_iter(state, i):
+        ro, _ = trainer._collect_jit(
+            state.params, state.iteration,
+            jax.random.fold_in(state.rng, i), None,
+        )
+        state, stats = trainer._update_jit(state, ro)
+        return state, ro
+
+    state, ro = one_iter(state, 0)  # compile + warm
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    n_timed = 2
+    total = 0
+    for i in range(1, 1 + n_timed):
+        state, ro = one_iter(state, i)
+        total += int(jax.block_until_ready(ro.valid).sum())
+    dt = time.perf_counter() - t0
+    value = total / dt
+    print(json.dumps({
+        "metric": f"ppo_train_steps_per_sec_{num_envs}envs",
+        "value": round(value, 1),
+        "unit": "steps/s",
+        "vs_baseline": round(value / TARGET, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    from sparksched_tpu.config import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    bench_inference()
+    bench_ppo()

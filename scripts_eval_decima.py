@@ -1,0 +1,163 @@
+"""Trained-Decima vs fair-scheduler evaluation on held-out seeds.
+
+Evaluates both schedulers on the SAME job sequences (seed-paired
+episodes) at the trained checkpoint's scale and reports per-seed and mean
+average job completion time — the reference's headline claim is that
+Decima beats the fair scheduler on avg JCT (/root/reference/README.md:5-7,
+examples.py:49-81). Writes EVAL.md.
+
+Usage: python scripts_eval_decima.py [num_seeds] [ckpt]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import numpy as np
+
+from sparksched_tpu import metrics
+from sparksched_tpu.config import EnvParams
+from sparksched_tpu.env import core
+from sparksched_tpu.schedulers import DecimaScheduler, RoundRobinScheduler
+from sparksched_tpu.trainers.rollout import collect_sync
+from sparksched_tpu.workload import make_workload_bank
+
+# the checkpoint's training scale (scripts_train_session.py env cfg)
+ENV = dict(num_executors=10, max_jobs=20, moving_delay=2000.0,
+           warmup_delay=1000.0, job_arrival_rate=4.0e-5)
+STEPS = 600  # decision cap; 20-job episodes finish well under this
+HELD_OUT_BASE = 10_000  # disjoint from training seeds (iteration-indexed)
+
+
+def episode_states(params, bank, seeds):
+    return jax.vmap(
+        lambda s: core.reset(params, bank, jax.random.PRNGKey(s))
+    )(seeds)
+
+
+def run_policy(params, bank, policy_fn, seeds):
+    states = episode_states(params, bank, seeds)
+    rngs = jax.vmap(
+        lambda s: jax.random.PRNGKey(s + 1)
+    )(seeds)
+
+    @jax.jit
+    def run(states, rngs):
+        return jax.vmap(
+            lambda r, s: collect_sync(params, bank, policy_fn, r, STEPS, s)
+        )(rngs, states)
+
+    import time
+
+    t0 = time.perf_counter()
+    ro = run(states, rngs)
+    fs = ro.final_state
+    done = np.asarray(jax.vmap(lambda s: s.all_jobs_complete)(fs))
+    ajd = np.asarray(jax.vmap(metrics.avg_job_duration)(fs))
+    print(f"  ({time.perf_counter() - t0:.0f}s)", flush=True)
+    return ajd, done
+
+
+def make_decima(params, ckpt):
+    return DecimaScheduler(
+        num_executors=params.num_executors,
+        embed_dim=16,
+        gnn_mlp_kwargs={
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+        state_dict_path=ckpt,
+    )
+
+
+CKPTS = {
+    "decima (tpu-trained)": "models/decima/model_tpu.msgpack",
+    "decima (reference ckpt, converted)": (
+        "/root/reference/models/decima/model.pt"
+    ),
+}
+
+
+def main():
+    num_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    ckpts = dict(CKPTS)
+    if len(sys.argv) > 2:
+        ckpts = {"decima": sys.argv[2]}
+    params = EnvParams(**ENV)
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    if bank.max_stages != params.max_stages:
+        params = params.replace(
+            max_stages=bank.max_stages, max_levels=bank.max_stages
+        )
+    seeds = jax.numpy.arange(
+        HELD_OUT_BASE, HELD_OUT_BASE + num_seeds
+    )
+
+    fair = RoundRobinScheduler(
+        params.num_executors, dynamic_partition=True
+    )
+    print("evaluating fair...", flush=True)
+    ajd_fair, done_fair = run_policy(
+        params, bank, lambda r, o: fair.policy(r, o), seeds
+    )
+    assert done_fair.all(), "unfinished fair episodes"
+
+    results = {}
+    for name, ckpt in ckpts.items():
+        print(f"evaluating {name}...", flush=True)
+        dec = make_decima(params, ckpt)
+        ajd, done = run_policy(
+            params, bank,
+            lambda r, o: dec.policy(r, o, dec.params), seeds,
+        )
+        assert done.all(), f"unfinished {name} episodes"
+        results[name] = ajd
+
+    header = (
+        "| seed | fair avg JCT (s) | "
+        + " | ".join(f"{n} (s)" for n in results)
+        + " |"
+    )
+    lines = [
+        "# Decima vs fair scheduler — held-out evaluation",
+        "",
+        "Seed-paired episodes: every scheduler sees the identical job "
+        "arrival sequence per seed (the reference's headline claim is "
+        "Decima < fair on avg job completion time, "
+        "/root/reference/README.md:5-7).",
+        f"Env: {ENV['num_executors']} executors, {ENV['max_jobs']} "
+        "TPC-H jobs (synthetic bank), held-out seeds "
+        f"{HELD_OUT_BASE}..{HELD_OUT_BASE + num_seeds - 1}.",
+        "",
+        header,
+        "|" + "---|" * (2 + len(results)),
+    ]
+    for i, s in enumerate(np.asarray(seeds)):
+        row = f"| {int(s)} | {ajd_fair[i] * 1e-3:.1f} |"
+        for ajd in results.values():
+            row += f" {ajd[i] * 1e-3:.1f} |"
+        lines.append(row)
+    lines.append("")
+    for name, ajd in results.items():
+        wins = int((ajd < ajd_fair).sum())
+        lines.append(
+            f"**{name}: mean avg JCT {ajd.mean() * 1e-3:.1f}s vs fair "
+            f"{ajd_fair.mean() * 1e-3:.1f}s "
+            f"({(1 - ajd.mean() / ajd_fair.mean()) * 100:+.1f}%), wins "
+            f"{wins}/{num_seeds} seeds.**"
+        )
+    lines.append("")
+    out = "\n".join(lines)
+    print(out)
+    with open("EVAL.md", "w") as fp:
+        fp.write(out)
+
+
+if __name__ == "__main__":
+    from sparksched_tpu.config import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    main()

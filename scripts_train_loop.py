@@ -1,0 +1,46 @@
+"""Resumable training loop: runs PPO sessions of a few iterations each,
+saving the full train state between sessions so progress survives kills.
+
+Platform comes from JAX_PLATFORMS (honored in-process); use cpu while the
+chip is busy/wedged, axon for the real chip.
+
+Usage: python scripts_train_loop.py [max_sessions] [iters_per_session]
+"""
+
+import os.path as osp
+import sys
+
+from sparksched_tpu.config import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+from flax import serialization  # noqa: E402
+import jax  # noqa: E402
+
+from sparksched_tpu.trainers import make_trainer  # noqa: E402
+from scripts_train_session import ART, CFG  # noqa: E402
+
+
+def main():
+    max_sessions = int(sys.argv[1]) if len(sys.argv) > 1 else 40
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    cfg = {**CFG, "trainer": {**CFG["trainer"], "num_iterations": iters}}
+    for s in range(max_sessions):
+        t = make_trainer(cfg)
+        resume = osp.join(ART, "train_state.msgpack")
+        state = t.train(
+            resume_from=resume if osp.isfile(resume) else None
+        )
+        with open(
+            "/root/repo/models/decima/model_tpu.msgpack", "wb"
+        ) as fp:
+            fp.write(serialization.to_bytes(jax.device_get(state.params)))
+        print(
+            f"session {s + 1}/{max_sessions} done at iteration "
+            f"{int(state.iteration)}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

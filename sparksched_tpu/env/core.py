@@ -47,6 +47,13 @@ def _onehot(n: int, e: jnp.ndarray) -> jnp.ndarray:
     return jnp.arange(n) == e
 
 
+def _onehot2(j_cap: int, s_cap: int, j: jnp.ndarray, s: jnp.ndarray
+             ) -> jnp.ndarray:
+    """bool[j_cap, s_cap] mask selecting exactly (j, s); all-false when
+    either index is out of range (e.g. -1 pool sentinels)."""
+    return _onehot(j_cap, j)[:, None] & _onehot(s_cap, s)[None, :]
+
+
 # --------------------------------------------------------------------------
 # schedulable-stage computation (reference :505-555)
 # --------------------------------------------------------------------------
@@ -74,7 +81,11 @@ def find_schedulable(
 def _refresh_sat(state: EnvState, j: jnp.ndarray, s: jnp.ndarray,
                  enable: jnp.ndarray = True) -> EnvState:
     """Recompute saturation of stage (j,s) after a demand mutation and
-    propagate the flip to its children's unsaturated-parent counts."""
+    propagate the flip to its children's unsaturated-parent counts.
+
+    Written as masked whole-array selects rather than `.at[j, s]`
+    scatters: under `jax.vmap` a batched scatter is a serialized kernel,
+    while broadcast+select fuses with the surrounding elementwise work."""
     demand = (
         state.stage_remaining[j, s]
         - state.moving_count[j, s]
@@ -88,13 +99,13 @@ def _refresh_sat(state: EnvState, j: jnp.ndarray, s: jnp.ndarray,
         new.astype(_i32) - old.astype(_i32),
         0,
     )
+    j_cap, s_cap = state.stage_sat.shape
+    oj = _onehot(j_cap, j)
+    m2 = oj[:, None] & _onehot(s_cap, s)[None, :]
     return state.replace(
-        stage_sat=state.stage_sat.at[j, s].set(
-            jnp.where(enable, new, old)
-        ),
-        unsat_parent_count=state.unsat_parent_count.at[j].add(
-            -delta * state.adj[j, s].astype(_i32)
-        ),
+        stage_sat=jnp.where(m2 & enable, new, state.stage_sat),
+        unsat_parent_count=state.unsat_parent_count
+        - delta * (oj[:, None] & state.adj[j, s][None, :]).astype(_i32),
     )
 
 
@@ -151,63 +162,6 @@ def _exec_location(state: EnvState, e: jnp.ndarray):
 RQ_NONE, RQ_START, RQ_MOVE = 0, 1, 2
 # resolved action kinds consumed by _apply_action
 A_NONE, A_START, A_SEND, A_IDLE, A_PARK = 0, 1, 2, 3, 4
-
-
-def _start_task(
-    params: EnvParams, state: EnvState, e: jnp.ndarray, j: jnp.ndarray,
-    s: jnp.ndarray, dur: jnp.ndarray
-) -> EnvState:
-    """reference _execute_next_task :584-615 with the duration pre-sampled
-    (see the structural note above)."""
-    seq = state.seq_counter
-    newly_saturated = state.stage_remaining[j, s] == 1
-    state = state.replace(
-        seq_counter=seq + 1,
-        stage_remaining=state.stage_remaining.at[j, s].add(-1),
-        stage_executing=state.stage_executing.at[j, s].add(1),
-        stage_duration=state.stage_duration.at[j, s].set(dur),
-        job_saturated_stages=state.job_saturated_stages.at[j].add(
-            newly_saturated.astype(_i32)
-        ),
-        exec_executing=state.exec_executing.at[e].set(True),
-        exec_task_valid=state.exec_task_valid.at[e].set(True),
-        exec_task_stage=state.exec_task_stage.at[e].set(s),
-        exec_finish_time=state.exec_finish_time.at[e].set(
-            state.wall_time + dur
-        ),
-        exec_finish_seq=state.exec_finish_seq.at[e].set(seq),
-    )
-    return _refresh_sat(state, j, s)
-
-
-def _send_executor(
-    params: EnvParams, state: EnvState, e: jnp.ndarray,
-    j: jnp.ndarray, s: jnp.ndarray
-) -> EnvState:
-    """reference :617-637 — detach, mark moving, push EXECUTOR_READY."""
-    old_job = state.exec_job[e]
-    seq = state.seq_counter
-    supply = state.job_supply.at[j].add(1)
-    supply = supply.at[jnp.maximum(old_job, 0)].add(
-        jnp.where(old_job >= 0, -1, 0)
-    )
-    state = state.replace(
-        seq_counter=seq + 1,
-        job_supply=supply,
-        moving_count=state.moving_count.at[j, s].add(1),
-        exec_at_common=state.exec_at_common.at[e].set(False),
-        exec_job=state.exec_job.at[e].set(-1),
-        exec_stage=state.exec_stage.at[e].set(-1),
-        exec_task_valid=state.exec_task_valid.at[e].set(False),
-        exec_moving=state.exec_moving.at[e].set(True),
-        exec_dst_job=state.exec_dst_job.at[e].set(j),
-        exec_dst_stage=state.exec_dst_stage.at[e].set(s),
-        exec_arrive_time=state.exec_arrive_time.at[e].set(
-            state.wall_time + params.moving_delay
-        ),
-        exec_arrive_seq=state.exec_arrive_seq.at[e].set(seq),
-    )
-    return _refresh_sat(state, j, s)
 
 
 # --------------------------------------------------------------------------
@@ -289,9 +243,17 @@ def _apply_action(
     """Apply a resolved action. The duration is sampled unconditionally
     here — the only bank access — so no conditional branch closes over the
     bank tables (see structural note above). The rng is advanced once per
-    call regardless of the action kind."""
+    call regardless of the action kind.
+
+    This is the hottest function in the engine (every micro-step and every
+    event-loop iteration ends here), so instead of a `lax.switch` over
+    START/SEND/IDLE/PARK branches full of `.at[e].set` scatters — under
+    vmap every branch executes anyway and batched scatters serialize — the
+    five action semantics (reference `_execute_next_task` :584-615,
+    `_send_executor` :617-637, `_move_idle_executors` :745-782, park) are
+    fused into one straight-line pass of masked whole-array selects, at
+    most one update per state field."""
     rng, sub = jax.random.split(state.rng)
-    state = state.replace(rng=rng)
     e = jnp.clip(e, 0, state.exec_job.shape[0] - 1)
     tpl = state.job_template[tj]
     num_local = (state.exec_job == tj).sum()
@@ -300,29 +262,94 @@ def _apply_action(
         state.exec_task_valid[e], state.exec_task_stage[e] == ts,
     )
 
-    def none(st: EnvState) -> EnvState:
-        return st
+    n = state.exec_job.shape[0]
+    j_cap, s_cap = state.stage_remaining.shape
+    one_e = _onehot(n, e)
+    oj = _onehot(j_cap, tj)
+    m2 = _onehot2(j_cap, s_cap, tj, ts)
 
-    def start(st: EnvState) -> EnvState:
-        st = st.replace(exec_stage=st.exec_stage.at[e].set(ts))
-        return _start_task(params, st, e, tj, ts, dur)
+    is_start = ak == A_START
+    is_send = ak == A_SEND
+    is_idle = ak == A_IDLE
+    is_park = ak == A_PARK
 
-    def send(st: EnvState) -> EnvState:
-        return _send_executor(params, st, e, tj, ts)
+    # IDLE = _move_idle_executors for the single executor e: no-op for the
+    # common pool and unsaturated job pools; saturated job -> common pool
+    pj, ps = _exec_location(state, e)
+    pool_sat = state.job_saturated[jnp.maximum(pj, 0)]
+    idle_eff = is_idle & ~((pj < 0) | ((ps < 0) & ~pool_sat))
+    idle_common = idle_eff & pool_sat
 
-    def idle(st: EnvState) -> EnvState:
-        pj, ps = _exec_location(st, e)
-        n = st.exec_job.shape[0]
-        return _move_idle_from_pool(st, pj, ps, _onehot(n, e))
+    # START/SEND bookkeeping read before any mutation
+    seq = state.seq_counter
+    old_job = state.exec_job[e]
+    newly_saturated = is_start & (state.stage_remaining[tj, ts] == 1)
 
-    def park(st: EnvState) -> EnvState:
-        # stage not ready yet: idle the executor in the job pool
-        return st.replace(
-            exec_task_valid=st.exec_task_valid.at[e].set(False),
-            exec_stage=st.exec_stage.at[e].set(-1),
-        )
+    i32_ = lambda b: b.astype(_i32)  # noqa: E731
+    m2_start = m2 & is_start
 
-    return lax.switch(ak, [none, start, send, idle, park], state)
+    state = state.replace(
+        rng=rng,
+        seq_counter=seq + i32_(is_start | is_send),
+        # --- executor fields (single slot e) ---
+        exec_stage=jnp.where(
+            one_e & (is_start | is_send | idle_eff | is_park),
+            jnp.where(is_start, ts, -1),
+            state.exec_stage,
+        ),
+        exec_task_valid=jnp.where(
+            one_e & (is_start | is_send | idle_common | is_park),
+            is_start,
+            state.exec_task_valid,
+        ),
+        exec_at_common=jnp.where(
+            one_e & (is_send | idle_common),
+            idle_common,
+            state.exec_at_common,
+        ),
+        exec_job=jnp.where(
+            one_e & (is_send | idle_common), -1, state.exec_job
+        ),
+        exec_moving=state.exec_moving | (one_e & is_send),
+        exec_dst_job=jnp.where(one_e & is_send, tj, state.exec_dst_job),
+        exec_dst_stage=jnp.where(
+            one_e & is_send, ts, state.exec_dst_stage
+        ),
+        exec_arrive_time=jnp.where(
+            one_e & is_send,
+            state.wall_time + params.moving_delay,
+            state.exec_arrive_time,
+        ),
+        exec_arrive_seq=jnp.where(
+            one_e & is_send, seq, state.exec_arrive_seq
+        ),
+        exec_executing=state.exec_executing | (one_e & is_start),
+        exec_task_stage=jnp.where(
+            one_e & is_start, ts, state.exec_task_stage
+        ),
+        exec_finish_time=jnp.where(
+            one_e & is_start,
+            state.wall_time + dur,
+            state.exec_finish_time,
+        ),
+        exec_finish_seq=jnp.where(
+            one_e & is_start, seq, state.exec_finish_seq
+        ),
+        # --- job fields ---
+        job_supply=state.job_supply
+        + i32_(oj & is_send)
+        - i32_(_onehot(j_cap, old_job) & is_send & (old_job >= 0)),
+        job_saturated_stages=state.job_saturated_stages
+        + i32_(oj & newly_saturated),
+        # --- stage fields ---
+        stage_remaining=state.stage_remaining - i32_(m2_start),
+        stage_executing=state.stage_executing + i32_(m2_start),
+        stage_duration=jnp.where(
+            m2_start, dur, state.stage_duration
+        ),
+        moving_count=state.moving_count + i32_(m2 & is_send),
+    )
+    return _refresh_sat(state, tj, ts, enable=is_start | is_send)
 
 
 # --------------------------------------------------------------------------
@@ -351,11 +378,12 @@ def _add_commitment(
     free = ~state.cm_valid
     take = free & (jnp.cumsum(free.astype(_i32)) <= n)
 
-    supply_delta = jnp.where((dj >= 0) & (dj != src_j), n, 0)
-    supply = state.job_supply.at[jnp.maximum(dj, 0)].add(supply_delta)
-    cc = state.commit_count.at[
-        jnp.maximum(dj, 0), jnp.maximum(ds, 0)
-    ].add(jnp.where(dj >= 0, n, 0))
+    j_cap, s_cap = state.commit_count.shape
+    oj = _onehot(j_cap, dj)  # all-false when dj == -1
+    supply = state.job_supply + n * (oj & (dj != src_j)).astype(_i32)
+    cc = state.commit_count + n * _onehot2(j_cap, s_cap, dj, ds).astype(
+        _i32
+    )
 
     state = state.replace(
         seq_counter=state.seq_counter + jnp.where(has_match, 0, 1),
@@ -407,13 +435,14 @@ def _fulfill_commitment_phase_a(
     dj = state.cm_dst_job[slot]
     ds = state.cm_dst_stage[slot]
     sj = state.cm_src_job[slot]
-    supply_delta = jnp.where((dj >= 0) & (dj != sj), -1, 0)
+    j_cap, s_cap = state.commit_count.shape
+    oj = _onehot(j_cap, dj)  # all-false when dj == -1
+    m2 = _onehot2(j_cap, s_cap, dj, ds)
     state = state.replace(
-        cm_valid=state.cm_valid.at[slot].set(False),
-        job_supply=state.job_supply.at[jnp.maximum(dj, 0)].add(supply_delta),
-        commit_count=state.commit_count.at[
-            jnp.maximum(dj, 0), jnp.maximum(ds, 0)
-        ].add(jnp.where(dj >= 0, -1, 0)),
+        cm_valid=state.cm_valid
+        & ~_onehot(state.cm_valid.shape[0], slot),
+        job_supply=state.job_supply - (oj & (dj != sj)).astype(_i32),
+        commit_count=state.commit_count - m2.astype(_i32),
     )
     state = _refresh_sat(
         state, jnp.maximum(dj, 0), jnp.maximum(ds, 0), enable=dj >= 0
@@ -504,7 +533,10 @@ def compute_node_levels(params: EnvParams, state: EnvState) -> jnp.ndarray:
 
 
 def _handle_job_arrival(state: EnvState, j: jnp.ndarray):
-    state = state.replace(job_arrived=state.job_arrived.at[j].set(True))
+    state = state.replace(
+        job_arrived=state.job_arrived
+        | _onehot(state.job_arrived.shape[0], j)
+    )
     has_common = state.exec_at_common.any()
     state = state.replace(
         source_valid=state.source_valid | has_common,
@@ -517,13 +549,17 @@ def _handle_job_arrival(state: EnvState, j: jnp.ndarray):
 def _handle_executor_ready(state: EnvState, e: jnp.ndarray):
     j = state.exec_dst_job[e]
     s = state.exec_dst_stage[e]
+    n = state.exec_job.shape[0]
+    j_cap, s_cap = state.moving_count.shape
+    one_e = _onehot(n, e)
+    m2 = _onehot2(j_cap, s_cap, j, s)
     state = state.replace(
-        moving_count=state.moving_count.at[j, s].add(-1),
-        exec_moving=state.exec_moving.at[e].set(False),
-        exec_arrive_time=state.exec_arrive_time.at[e].set(INF),
-        exec_at_common=state.exec_at_common.at[e].set(False),
-        exec_job=state.exec_job.at[e].set(j),
-        exec_stage=state.exec_stage.at[e].set(-1),
+        moving_count=state.moving_count - m2.astype(_i32),
+        exec_moving=state.exec_moving & ~one_e,
+        exec_arrive_time=jnp.where(one_e, INF, state.exec_arrive_time),
+        exec_at_common=state.exec_at_common & ~one_e,
+        exec_job=jnp.where(one_e, j, state.exec_job),
+        exec_stage=jnp.where(one_e, -1, state.exec_stage),
     )
     state = _refresh_sat(state, j, s)
     return state, _i32(RQ_MOVE), j, s
@@ -533,13 +569,18 @@ def _handle_task_finished(state: EnvState, e: jnp.ndarray):
     j = state.exec_job[e]
     s = state.exec_task_stage[e]
     n = state.exec_job.shape[0]
+    j_cap, s_cap = state.stage_executing.shape
+    one_e = _onehot(n, e)
+    oj = _onehot(j_cap, j)
+    m2 = oj[:, None] & _onehot(s_cap, s)[None, :]
     frontier_before = state.frontier[j]
 
     state = state.replace(
-        stage_executing=state.stage_executing.at[j, s].add(-1),
-        stage_completed_tasks=state.stage_completed_tasks.at[j, s].add(1),
-        exec_executing=state.exec_executing.at[e].set(False),
-        exec_finish_time=state.exec_finish_time.at[e].set(INF),
+        stage_executing=state.stage_executing - m2.astype(_i32),
+        stage_completed_tasks=state.stage_completed_tasks
+        + m2.astype(_i32),
+        exec_executing=state.exec_executing & ~one_e,
+        exec_finish_time=jnp.where(one_e, INF, state.exec_finish_time),
     )
 
     def more_tasks(st: EnvState):
@@ -550,8 +591,9 @@ def _handle_task_finished(state: EnvState, e: jnp.ndarray):
         # maintain the frontier cache: one fewer incomplete parent for
         # every child of a completed stage
         st = st.replace(
-            incomplete_parent_count=st.incomplete_parent_count.at[j].add(
-                -stage_done.astype(_i32) * st.adj[j, s].astype(_i32)
+            incomplete_parent_count=st.incomplete_parent_count
+            - (stage_done & oj[:, None] & st.adj[j, s][None, :]).astype(
+                _i32
             )
         )
         new_frontier = st.frontier[j] & ~frontier_before
@@ -562,7 +604,9 @@ def _handle_task_finished(state: EnvState, e: jnp.ndarray):
             pool = st.pool_member_mask(j, _i32(-1)) & ~st.exec_executing
             st = _move_idle_from_pool(st, j, _i32(-1), pool)
             return st.replace(
-                job_t_completed=st.job_t_completed.at[j].set(st.wall_time)
+                job_t_completed=jnp.where(
+                    oj, st.wall_time, st.job_t_completed
+                )
             )
 
         st = lax.cond(
@@ -577,7 +621,7 @@ def _handle_task_finished(state: EnvState, e: jnp.ndarray):
 
         def no_cm(st: EnvState):
             st = st.replace(
-                exec_task_valid=st.exec_task_valid.at[e].set(False)
+                exec_task_valid=st.exec_task_valid & ~one_e
             )
             st = lax.cond(
                 did_change,
@@ -838,9 +882,9 @@ def step(
         n = jnp.clip(num_exec, 1, committable)
         n = jnp.minimum(n, st.exec_demand[j, s])  # _adjust_num_executors
         st = _add_commitment(st, n, j, s)
-        st = st.replace(
-            stage_selected=st.stage_selected.at[j, s].set(True)
-        )
+        j_cap, s_cap2 = st.stage_selected.shape
+        sel = _onehot2(j_cap, s_cap2, j, s)
+        st = st.replace(stage_selected=st.stage_selected | sel)
         sched = find_schedulable(params, st, st.source_job_id())
         return st.replace(schedulable=sched)
 

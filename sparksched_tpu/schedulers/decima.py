@@ -24,6 +24,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 from flax import struct
 
@@ -32,7 +33,10 @@ from .base import TrainableScheduler
 
 NUM_NODE_FEATURES = 5  # reference env_wrapper.py:9
 NUM_DAG_FEATURES = 3  # reference scheduler.py:34
-NEG_INF = jnp.float32(-1e30)
+# numpy scalar, not jnp: a jax array here would initialize the backend
+# (and claim the TPU) on `import sparksched_tpu.schedulers` — see the
+# matching note in env/state.py
+NEG_INF = np.float32(-1e30)
 
 _i32 = jnp.int32
 
@@ -494,7 +498,6 @@ def load_torch_state_dict(path: str, params):
     """Convert a reference torch checkpoint (scheduler.py:57-59) into this
     module's parameter pytree. Torch `Sequential` indices map to dense
     layer indices (Linear layers sit at even indices)."""
-    import numpy as np
     import torch
 
     sd = torch.load(path, map_location="cpu", weights_only=True)

@@ -14,10 +14,11 @@ from .reference_fixtures import (
 )
 
 
+@pytest.mark.parametrize("burst", [1, 4])
 @pytest.mark.parametrize(
     "spec_fn,num_exec", [(spec_diamond, 4), (lambda: spec_multi_job(4, 11), 5)]
 )
-def test_flat_loop_matches_step_loop(spec_fn, num_exec):
+def test_flat_loop_matches_step_loop(spec_fn, num_exec, burst):
     import jax
     import jax.numpy as jnp
 
@@ -46,7 +47,8 @@ def test_flat_loop_matches_step_loop(spec_fn, num_exec):
 
     ls = jax.jit(
         lambda s, r: run_flat(
-            params, bank, pol, r, 40 * decisions, s, auto_reset=False
+            params, bank, pol, r, 40 * decisions // burst, s,
+            auto_reset=False, event_burst=burst,
         )
     )(state0, jax.random.PRNGKey(0))
 
@@ -59,3 +61,29 @@ def test_flat_loop_matches_step_loop(spec_fn, num_exec):
         np.asarray(ls.env.job_t_completed),
         np.asarray(state.job_t_completed), rtol=1e-6,
     )
+
+
+def test_event_micro_step_leaves_non_event_lanes_untouched():
+    """A lane in DECIDE/FULFILL mode must be bit-identical after an
+    event-only sub-step (including its rng chain and counters)."""
+    import jax
+
+    from sparksched_tpu.env.flat_loop import (
+        M_DECIDE,
+        event_micro_step,
+        init_loop_state,
+    )
+
+    spec = spec_diamond()
+    params, bank, state0 = make_tpu_env_state(spec, 4)
+    ls = init_loop_state(state0)
+    assert int(ls.mode) == M_DECIDE
+
+    out = jax.jit(
+        lambda l, r: event_micro_step(params, bank, l, r)
+    )(ls, jax.random.PRNGKey(3))
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(ls)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
