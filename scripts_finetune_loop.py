@@ -1,5 +1,6 @@
 """Fine-tune Decima from the converted reference checkpoint on the
-synthetic workload bank (resumable sessions, like scripts_train_loop).
+synthetic workload bank (resumable sessions; shared loop in
+scripts_train_loop).
 
 The reference ships pretrained weights (models/decima/model.pt,
 examples.py:69); our converter loads them into the flax model
@@ -10,53 +11,17 @@ own warm-start workflow (state_dict_path, decima/scheduler.py:57-59).
 Usage: python scripts_finetune_loop.py [max_sessions] [iters_per_session]
 """
 
-import os.path as osp
 import sys
 
-from sparksched_tpu.config import honor_jax_platforms_env
-
-honor_jax_platforms_env()
-
-from flax import serialization  # noqa: E402
-import jax  # noqa: E402
-
-from sparksched_tpu.trainers import make_trainer  # noqa: E402
-from scripts_train_session import CFG  # noqa: E402
-
-ART = "/root/repo/artifacts/decima_ft"
-OUT = "/root/repo/models/decima/model_ft.msgpack"
-
-
-def main():
-    max_sessions = int(sys.argv[1]) if len(sys.argv) > 1 else 40
-    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 3
-    cfg = {
-        **CFG,
-        "trainer": {
-            **CFG["trainer"],
-            "num_iterations": iters,
-            "artifacts_dir": ART,
-        },
-        "agent": {
-            **CFG["agent"],
-            # warm start: converted reference pretrained weights
-            "state_dict_path": "/root/reference/models/decima/model.pt",
-        },
-    }
-    for s in range(max_sessions):
-        t = make_trainer(cfg)
-        resume = osp.join(ART, "train_state.msgpack")
-        state = t.train(
-            resume_from=resume if osp.isfile(resume) else None
-        )
-        with open(OUT, "wb") as fp:
-            fp.write(serialization.to_bytes(jax.device_get(state.params)))
-        print(
-            f"session {s + 1}/{max_sessions} done at iteration "
-            f"{int(state.iteration)}",
-            flush=True,
-        )
-
+from scripts_train_loop import run_sessions
 
 if __name__ == "__main__":
-    main()
+    run_sessions(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 40,
+        int(sys.argv[2]) if len(sys.argv) > 2 else 3,
+        artifacts_dir="/root/repo/artifacts/decima_ft",
+        out_path="/root/repo/models/decima/model_ft.msgpack",
+        agent_overrides={
+            "state_dict_path": "/root/reference/models/decima/model.pt"
+        },
+    )

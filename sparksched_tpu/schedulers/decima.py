@@ -133,17 +133,27 @@ def make_act(name: str, kwargs: Any = None) -> Callable:
 
 class MLP(nn.Module):
     """Dense stack matching reference utils.make_mlp:45-64 (all biases
-    start at zero per scheduler.py:66-69 `_reset_biases`)."""
+    start at zero per scheduler.py:66-69 `_reset_biases`).
+
+    `dtype` is the *compute* dtype (params stay f32): bfloat16 keeps the
+    matmuls on the MXU's native precision — the TPU analog of the
+    reference's f32 torch path."""
 
     hid_dims: tuple[int, ...]
     out_dim: int
     act: Callable
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         for i, d in enumerate(self.hid_dims):
-            x = self.act(nn.Dense(d, name=f"dense_{i}")(x))
-        return nn.Dense(self.out_dim, name=f"dense_{len(self.hid_dims)}")(x)
+            x = self.act(
+                nn.Dense(d, name=f"dense_{i}", dtype=self.dtype)(x)
+            )
+        return nn.Dense(
+            self.out_dim, name=f"dense_{len(self.hid_dims)}",
+            dtype=self.dtype,
+        )(x)
 
 
 class DecimaNet(nn.Module):
@@ -163,22 +173,31 @@ class DecimaNet(nn.Module):
     gnn_act_kwargs: Any = None
     policy_act: str = "Tanh"
     policy_act_kwargs: Any = None
+    # compute dtype for all Dense layers + message aggregation; params
+    # stay f32. "bfloat16" puts the matmuls on the MXU's native input
+    # precision; scores are returned as f32 either way.
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, f: DecimaFeatures):
         g_act = make_act(self.gnn_act, self.gnn_act_kwargs)
         p_act = make_act(self.policy_act, self.policy_act_kwargs)
         d = self.embed_dim
+        cdt = (
+            jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+        )
 
-        mlp_prep = MLP(self.gnn_hid, d, g_act, name="mlp_prep")
-        mlp_msg = MLP(self.gnn_hid, d, g_act, name="mlp_msg")
-        mlp_update = MLP(self.gnn_hid, d, g_act, name="mlp_update")
+        mlp_prep = MLP(self.gnn_hid, d, g_act, name="mlp_prep", dtype=cdt)
+        mlp_msg = MLP(self.gnn_hid, d, g_act, name="mlp_msg", dtype=cdt)
+        mlp_update = MLP(
+            self.gnn_hid, d, g_act, name="mlp_update", dtype=cdt
+        )
 
         # --- NodeEncoder (reference scheduler.py:173-241) ---
         # h[leaf] = update(prep(x)); h[p] = prep(x)[p] + update(sum_children
         # msg(h[c])), computed one topological generation at a time from the
         # deepest level up (reverse_flow=True, leaf-to-root).
-        x = f.x
+        x = f.x.astype(cdt) if cdt is not None else f.x
         s_cap = x.shape[-2]
         h_init = mlp_prep(x)
         adj_f = f.adj.astype(h_init.dtype)
@@ -202,13 +221,15 @@ class DecimaNet(nn.Module):
         h_node = jnp.where(f.node_mask[..., None], h_node, 0.0)
 
         # --- DagEncoder (reference scheduler.py:244-257) ---
-        z = MLP(self.gnn_hid, d, g_act, name="mlp_dag")(
+        z = MLP(self.gnn_hid, d, g_act, name="mlp_dag", dtype=cdt)(
             jnp.concatenate([x, h_node], axis=-1)
         )
         h_dag = jnp.where(f.node_mask[..., None], z, 0.0).sum(axis=-2)
 
         # --- GlobalEncoder (reference scheduler.py:260-276) ---
-        zg = MLP(self.gnn_hid, d, g_act, name="mlp_glob")(h_dag)
+        zg = MLP(
+            self.gnn_hid, d, g_act, name="mlp_glob", dtype=cdt
+        )(h_dag)
         h_glob = jnp.where(f.job_mask[..., None], zg, 0.0).sum(axis=-2)
 
         # --- StagePolicyNetwork (reference scheduler.py:279-320) ---
@@ -222,9 +243,9 @@ class DecimaNet(nn.Module):
         stage_in = jnp.concatenate(
             [x, h_node, h_dag_rpt, h_glob_rpt], axis=-1
         )
-        stage_scores = MLP(self.policy_hid, 1, p_act, name="mlp_stage")(
-            stage_in
-        )[..., 0]
+        stage_scores = MLP(
+            self.policy_hid, 1, p_act, name="mlp_stage", dtype=cdt
+        )(stage_in)[..., 0].astype(jnp.float32)
 
         # --- ExecPolicyNetwork (reference scheduler.py:323-385) ---
         # x_dag = first NUM_DAG_FEATURES features of each dag's first node;
@@ -252,9 +273,9 @@ class DecimaNet(nn.Module):
             ],
             axis=-1,
         )
-        exec_scores = MLP(self.policy_hid, 1, p_act, name="mlp_exec")(
-            exec_in
-        )[..., 0]
+        exec_scores = MLP(
+            self.policy_hid, 1, p_act, name="mlp_exec", dtype=cdt
+        )(exec_in)[..., 0].astype(jnp.float32)
 
         return stage_scores, exec_scores
 
@@ -379,6 +400,7 @@ class DecimaScheduler(TrainableScheduler):
         seed: int = 42,
         num_tasks_scale: float = 200.0,
         work_scale: float = 1e5,
+        compute_dtype: str | None = None,
         **_: Any,
     ) -> None:
         self.name = "Decima"
@@ -396,6 +418,7 @@ class DecimaScheduler(TrainableScheduler):
             gnn_act_kwargs=_hashable(gnn_mlp_kwargs.get("act_kwargs")),
             policy_act=policy_mlp_kwargs.get("act_cls", "Tanh"),
             policy_act_kwargs=_hashable(policy_mlp_kwargs.get("act_kwargs")),
+            compute_dtype=compute_dtype,
         )
         self.params = self.init_params(jax.random.PRNGKey(seed))
         if state_dict_path:

@@ -683,3 +683,50 @@ def test_decima_forward_matches_reference_torch_checkpoint():
         ours_stage, ref_nodes.numpy(), rtol=1e-5, atol=1e-5,
         err_msg="stage scores diverge",
     )
+
+
+def test_decima_bf16_compute_close_to_f32():
+    """compute_dtype='bfloat16' (MXU-native matmuls, f32 params) must
+    track the f32 forward within bf16 tolerance and keep f32 outputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.schedulers import DecimaScheduler
+    from sparksched_tpu.schedulers.decima import _dummy_features
+
+    kw = dict(
+        num_executors=10,
+        embed_dim=16,
+        gnn_mlp_kwargs={
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+        seed=3,
+    )
+    f32 = DecimaScheduler(**kw)
+    bf16 = DecimaScheduler(**kw, compute_dtype="bfloat16")
+    # identical f32 params regardless of compute dtype
+    for a, b in zip(
+        jax.tree_util.tree_leaves(f32.params),
+        jax.tree_util.tree_leaves(bf16.params),
+    ):
+        assert a.dtype == jnp.float32 and b.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    feats = _dummy_features(10)
+    feats = feats.replace(
+        x=jax.random.normal(jax.random.PRNGKey(0), feats.x.shape),
+        adj=feats.adj.at[0, 0, 1].set(True).at[0, 1, 2].set(True),
+        node_level=feats.node_level.at[0, 1].set(1).at[0, 2].set(2),
+    )
+    s32, e32 = f32.net.apply(f32.params, feats)
+    s16, e16 = bf16.net.apply(bf16.params, feats)
+    assert s16.dtype == jnp.float32 and e16.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(s16), np.asarray(s32), rtol=0.05, atol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(e16), np.asarray(e32), rtol=0.05, atol=0.05
+    )
