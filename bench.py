@@ -132,7 +132,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    from sparksched_tpu.config import honor_jax_platforms_env
+    from sparksched_tpu.config import (
+        enable_compilation_cache,
+        honor_jax_platforms_env,
+    )
 
     honor_jax_platforms_env()
+    enable_compilation_cache()
     main()

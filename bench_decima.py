@@ -32,7 +32,10 @@ from sparksched_tpu.workload import make_workload_bank
 TARGET = 50_000.0
 
 
-def bench_inference(num_envs: int = 64, steps: int = 512) -> None:
+def bench_inference(
+    num_envs: int = 64, steps: int = 512,
+    compute_dtype: str | None = None,
+) -> None:
     params = EnvParams(
         num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
         moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
@@ -52,6 +55,7 @@ def bench_inference(num_envs: int = 64, steps: int = 512) -> None:
             "act_kwargs": {"negative_slope": 0.2},
         },
         policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+        compute_dtype=compute_dtype,
     )
 
     def pol(rng, obs):
@@ -77,8 +81,9 @@ def bench_inference(num_envs: int = 64, steps: int = 512) -> None:
         total += int(jax.block_until_ready(ro.valid).sum())
     dt = time.perf_counter() - t0
     value = total / dt
+    tag = f"_{compute_dtype}" if compute_dtype else ""
     print(json.dumps({
-        "metric": f"decima_infer_steps_per_sec_{num_envs}envs",
+        "metric": f"decima_infer_steps_per_sec_{num_envs}envs{tag}",
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
@@ -148,8 +153,13 @@ def bench_ppo(num_envs: int = 1024, rollout_steps: int = 256) -> None:
 
 
 if __name__ == "__main__":
-    from sparksched_tpu.config import honor_jax_platforms_env
+    from sparksched_tpu.config import (
+        enable_compilation_cache,
+        honor_jax_platforms_env,
+    )
 
     honor_jax_platforms_env()
+    enable_compilation_cache()
     bench_inference()
+    bench_inference(compute_dtype="bfloat16")
     bench_ppo()

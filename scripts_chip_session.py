@@ -1,0 +1,108 @@
+"""One-process chip session: everything that needs the real TPU, run
+sequentially under a single client (one tunnel grant, no concurrent
+claims — see PERF.md's operational rules).
+
+Stages (each guarded; a failure logs and moves on):
+  1. sanity matmul (fail fast if the tunnel is wedged)
+  2. burst sweep at the requested burst values
+  3. headline bench (bench.py main)
+  4. Decima benches (inference + PPO throughput)
+  5. flagship-scale compile/step check (config/decima_tpch.yaml shapes,
+     one tiny iteration)
+
+Usage: python scripts_chip_session.py [stage ...]   (default: 1 2 3 4)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+import traceback
+
+from sparksched_tpu.config import (
+    enable_compilation_cache,
+    honor_jax_platforms_env,
+)
+
+honor_jax_platforms_env()
+enable_compilation_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def stage_sanity():
+    t0 = time.time()
+    y = (jnp.ones((512, 512)) @ jnp.ones((512, 512))).sum()
+    jax.block_until_ready(y)
+    print(f"[sanity] chip alive in {time.time() - t0:.1f}s "
+          f"on {jax.devices()}", flush=True)
+
+
+def stage_sweep():
+    import scripts_burst_sweep
+
+    scripts_burst_sweep.main()
+
+
+def stage_bench():
+    import bench
+
+    bench.main()
+
+
+def stage_bench_decima():
+    import bench_decima
+
+    bench_decima.bench_inference()
+    bench_decima.bench_inference(compute_dtype="bfloat16")
+    bench_decima.bench_ppo()
+
+
+def stage_flagship():
+    """Flagship-scale (decima_tpch.yaml env/agent shapes) compile + one
+    tiny training iteration: 200-job cap, 50 executors, short scan."""
+    import yaml
+
+    from sparksched_tpu.trainers.trainer import make_trainer
+
+    with open("config/decima_tpch.yaml") as fp:
+        cfg = yaml.safe_load(fp)
+    cfg["trainer"] |= {
+        "num_iterations": 1,
+        "num_sequences": 2,
+        "num_rollouts": 2,
+        "rollout_steps": 1200,
+        "use_tensorboard": False,
+        "artifacts_dir": "/tmp/flagship_check",
+        "checkpointing_freq": 10**9,
+    }
+    t = make_trainer(cfg)
+    t0 = time.time()
+    state = t.train()
+    print(f"[flagship] 1 iteration at 200-job/50-exec scale in "
+          f"{time.time() - t0:.0f}s (iteration={int(state.iteration)})",
+          flush=True)
+
+
+STAGES = {
+    "1": ("sanity", stage_sanity),
+    "2": ("burst sweep", stage_sweep),
+    "3": ("headline bench", stage_bench),
+    "4": ("decima benches", stage_bench_decima),
+    "5": ("flagship check", stage_flagship),
+}
+
+
+if __name__ == "__main__":
+    picks = sys.argv[1:] or ["1", "2", "3", "4"]
+    for p in picks:
+        name, fn = STAGES[p]
+        print(f"=== stage {p}: {name} ===", flush=True)
+        try:
+            fn()
+        except Exception:
+            traceback.print_exc()
+            if p == "1":
+                print("chip unavailable; aborting session", flush=True)
+                break

@@ -10,9 +10,13 @@ Usage: python scripts_train_loop.py [max_sessions] [iters_per_session]
 import os.path as osp
 import sys
 
-from sparksched_tpu.config import honor_jax_platforms_env
+from sparksched_tpu.config import (
+    enable_compilation_cache,
+    honor_jax_platforms_env,
+)
 
 honor_jax_platforms_env()
+enable_compilation_cache()
 
 from flax import serialization  # noqa: E402
 import jax  # noqa: E402

@@ -472,10 +472,18 @@ class DecimaScheduler(TrainableScheduler):
     def evaluate_actions(self, params, feats: DecimaFeatures,
                          actions: DecimaAction):
         """Batched log-probs/entropies; `feats`/`actions` have leading batch
-        axes (reference scheduler.py:101-139)."""
+        axes (reference scheduler.py:101-139).
+
+        The forward is rematerialized (`jax.checkpoint`): the unrolled
+        S-level GNN would otherwise keep every level's activations alive
+        for the backward pass across the whole minibatch — the memory
+        wall at the flagship 200-job/20-stage scale. Remat trades one
+        recomputed forward for ~S x less live activation memory."""
 
         def one(f, a):
-            stage_scores, exec_scores = self.net.apply(params, f)
+            stage_scores, exec_scores = jax.checkpoint(
+                lambda p, ff: self.net.apply(p, ff)
+            )(params, f)
             return evaluate_actions(
                 stage_scores, exec_scores, f, a, self.num_executors
             )
