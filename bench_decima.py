@@ -116,7 +116,10 @@ def bench_ppo(num_envs: int = 1024, rollout_steps: int = 256) -> None:
         "seed": 0,
         "use_tensorboard": False,
         "num_epochs": 3,
-        "num_batches": 8,
+        # minibatch = num_envs*rollout_steps/num_batches; features alone
+        # are [minibatch, J, S, 5] f32 in the update, so keep minibatches
+        # to a few thousand steps
+        "num_batches": 64,
         "beta_discount": 5.0e-3,
         "opt_kwargs": {"lr": 3.0e-4},
         "max_grad_norm": 0.5,

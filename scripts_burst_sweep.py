@@ -21,7 +21,7 @@ NUM_ENVS = 1024
 SUB = 512
 
 
-def main():
+def main(bursts=(1, 4, 8, 16)):
     params = EnvParams(
         num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
         moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
@@ -59,11 +59,6 @@ def main():
     states = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
     ls0 = jax.vmap(init_loop_state)(states)
 
-    import sys
-
-    bursts = tuple(
-        int(b) for b in (sys.argv[1:] or ["1", "4", "8"])
-    )
     for burst in bursts:
         groups = max(1, 256 // burst)  # ~256 micro-steps per chunk
         # warm into steady state + compile
@@ -96,6 +91,11 @@ if __name__ == "__main__":
         honor_jax_platforms_env,
     )
 
+    import sys
+
     honor_jax_platforms_env()
     enable_compilation_cache()
-    main()
+    if len(sys.argv) > 1:
+        main(tuple(int(b) for b in sys.argv[1:]))
+    else:
+        main()

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Poll the TPU tunnel; the moment it answers, run the full chip session
+# (benches + flagship check) in this same process slot and exit.
+# Output: /tmp/chip_watch.log
+cd /root/repo
+for i in $(seq 1 200); do
+  if timeout 120 python -c "
+import jax
+jax.config.update('jax_compilation_cache_dir', '/root/repo/.jax_cache')
+import jax.numpy as jnp
+jax.block_until_ready((jnp.ones((256,256)) @ jnp.ones((256,256))).sum())
+print('ALIVE')
+" 2>/dev/null | grep -q ALIVE; then
+    echo "chip alive at $(date +%H:%M:%S); running session"
+    timeout 3500 python scripts_chip_session.py 1 2 3 4 5
+    echo "session rc=$? at $(date +%H:%M:%S)"
+    exit 0
+  fi
+  echo "watch $i: wedged at $(date +%H:%M:%S)"
+  sleep 240
+done

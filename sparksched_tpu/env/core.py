@@ -506,16 +506,11 @@ def _fulfill_from_source(
 # --------------------------------------------------------------------------
 
 
-def compute_node_levels(params: EnvParams, state: EnvState) -> jnp.ndarray:
-    """i32[J,S]: topological generation of each active stage within the
-    ACTIVE subgraph (completed stages excluded), padding = S. Matches
-    nx.topological_generations on the observed dag batch (reference
-    decima/utils.py:238-267). Computed once per observation rather than
-    incrementally per event: a 20-deep dependent-op chain inside the event
-    while-loop was pure latency on TPU."""
-    s_cap = state.stage_exists.shape[1]
-    active = state.stage_exists & ~state.stage_completed
-    adj_act = state.adj & active[:, :, None] & active[:, None, :]
+def topo_levels(active: jnp.ndarray, adj_act: jnp.ndarray) -> jnp.ndarray:
+    """i32[J,S] topological generation of each active node in the masked
+    subgraph; padding = S. Matches nx.topological_generations on the
+    observed dag batch (reference decima/utils.py:238-267)."""
+    s_cap = active.shape[1]
 
     def body(_, lvl):
         cand = jnp.where(adj_act, lvl[:, :, None] + 1, 0).max(axis=1)
@@ -525,6 +520,16 @@ def compute_node_levels(params: EnvParams, state: EnvState) -> jnp.ndarray:
         0, s_cap, body, jnp.zeros(active.shape, _i32)
     )
     return jnp.where(active, lvl, s_cap)
+
+
+def compute_node_levels(params: EnvParams, state: EnvState) -> jnp.ndarray:
+    """Active-subgraph topological generations (completed stages
+    excluded). Computed once per observation rather than incrementally
+    per event: a 20-deep dependent-op chain inside the event while-loop
+    was pure latency on TPU."""
+    active = state.stage_exists & ~state.stage_completed
+    adj_act = state.adj & active[:, :, None] & active[:, None, :]
+    return topo_levels(active, adj_act)
 
 
 # --------------------------------------------------------------------------

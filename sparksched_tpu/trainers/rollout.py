@@ -47,7 +47,6 @@ class StoredObs(struct.PyTreeNode):
     schedulable: jnp.ndarray  # bool[J,S]
     node_mask: jnp.ndarray  # bool[J,S]
     job_mask: jnp.ndarray  # bool[J]
-    node_level: jnp.ndarray  # i32[J,S]
     job_template: jnp.ndarray  # i32[J]
     exec_supplies: jnp.ndarray  # i32[J]
     num_committable: jnp.ndarray  # i32 []
@@ -61,7 +60,6 @@ def store_obs(obs: Observation, state: EnvState) -> StoredObs:
         schedulable=obs.schedulable,
         node_mask=obs.node_mask,
         job_mask=obs.job_mask,
-        node_level=obs.node_level,
         job_template=state.job_template,
         exec_supplies=obs.exec_supplies,
         num_committable=obs.num_committable,
@@ -70,7 +68,12 @@ def store_obs(obs: Observation, state: EnvState) -> StoredObs:
 
 
 def stored_to_observation(bank: WorkloadBank, so: StoredObs) -> Observation:
-    """Rebuild the padded Observation a stored step was taken from."""
+    """Rebuild the padded Observation a stored step was taken from.
+
+    `node_level` is recomputed from the reconstructed active-subgraph
+    adjacency rather than stored: an i32[J,S] per step was ~30% of the
+    rollout buffer at the flagship 200-job scale, and the S-deep level
+    recursion is a small fraction of the GNN work the observation feeds."""
     adj = (
         bank.adj[so.job_template]
         & so.node_mask[:, :, None]
@@ -91,7 +94,7 @@ def stored_to_observation(bank: WorkloadBank, so: StoredObs) -> Observation:
         schedulable=so.schedulable,
         frontier=jnp.zeros_like(so.schedulable),  # not needed by any model
         adj=adj,
-        node_level=so.node_level,
+        node_level=core.topo_levels(so.node_mask, adj),
         exec_supplies=so.exec_supplies,
         num_committable=so.num_committable,
         source_job=so.source_job,

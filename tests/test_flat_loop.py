@@ -87,3 +87,42 @@ def test_event_micro_step_leaves_non_event_lanes_untouched():
         jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(ls)
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_run_flat_loop_state_resume_matches_single_run():
+    """Chunked runs resuming via `loop_state` (the bench pattern) must
+    reach the same final state as one continuous run when the rng only
+    feeds unused reset keys (deterministic policy, no auto-reset)."""
+    import jax
+
+    from sparksched_tpu.env.flat_loop import run_flat
+    from sparksched_tpu.schedulers import round_robin_policy
+
+    spec = spec_diamond()
+    params, bank, state0 = make_tpu_env_state(spec, 4)
+
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, 4, True)
+        return si, ne, {}
+
+    whole = jax.jit(
+        lambda s, r: run_flat(
+            params, bank, pol, r, 120, s, auto_reset=False
+        )
+    )(state0, jax.random.PRNGKey(0))
+
+    chunked = jax.jit(
+        lambda s, r: run_flat(
+            params, bank, pol, r, 60, s, auto_reset=False
+        )
+    )(state0, jax.random.PRNGKey(1))
+    chunked = jax.jit(
+        lambda ls, r: run_flat(
+            params, bank, pol, r, 60, auto_reset=False, loop_state=ls
+        )
+    )(chunked, jax.random.PRNGKey(2))
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(whole), jax.tree_util.tree_leaves(chunked)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
