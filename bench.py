@@ -38,15 +38,24 @@ from sparksched_tpu.env.flat_loop import init_loop_state, run_flat
 from sparksched_tpu.schedulers.heuristics import round_robin_policy
 from sparksched_tpu.workload import make_workload_bank
 
+import os
+
 NUM_ENVS = 1024
 # the tunneled v5e faults on >=1024-lane vmaps of the full step (kernel
 # fault at exactly the 8x128 tile boundary); process lanes in sub-batches
-# of 512 via lax.map inside one jit — same program, bounded vector width
-SUB_BATCH = 512
+# of 512 via lax.map inside one jit — same program, bounded vector width.
+# Overridable via env vars for on-chip tuning without edits.
+SUB_BATCH = int(os.environ.get("BENCH_SUB_BATCH", 512))
 # the tunnel also kills device programs that run for tens of seconds, so
 # keep each timed program short and accumulate across calls
-BURST = 8  # event-only sub-steps per full micro-step (incl. the full one)
+BURST = int(os.environ.get("BENCH_BURST", 8))  # event sub-steps per group
 MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
+assert NUM_ENVS % SUB_BATCH == 0, (
+    f"BENCH_SUB_BATCH={SUB_BATCH} must divide {NUM_ENVS}"
+)
+assert 1 <= BURST <= MICRO_CHUNK and MICRO_CHUNK % BURST == 0, (
+    f"BENCH_BURST={BURST} must be a divisor of {MICRO_CHUNK}"
+)
 NUM_CHUNKS = 4
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 

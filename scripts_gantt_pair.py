@@ -1,0 +1,33 @@
+"""Generate the README-style Gantt comparison: fair vs (converted)
+pretrained Decima on the same seed (reference README.md:5-7 figure).
+
+Writes artifacts/gantt_fair.png and artifacts/gantt_decima.png.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from sparksched_tpu.config import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import examples  # noqa: E402
+
+if __name__ == "__main__":
+    n_jobs = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    examples.ENV_CFG["max_jobs"] = n_jobs
+    os.makedirs("/root/repo/artifacts", exist_ok=True)
+    os.chdir("/root/repo/artifacts")
+    for name, ckpt, out in [
+        ("fair", None, "gantt_fair.png"),
+        ("decima", "/root/reference/models/decima/model.pt",
+         "gantt_decima.png"),
+    ]:
+        sched = examples.make_scheduler(name, ckpt)
+        avg = examples.run_episode(
+            sched, seed=7, render=True, max_steps=6000
+        )
+        os.rename("screenshot.png", out)
+        print(f"{name}: avg JCT {avg * 1e-3:.1f}s -> {out}", flush=True)

@@ -523,11 +523,17 @@ def topo_levels(active: jnp.ndarray, adj_act: jnp.ndarray) -> jnp.ndarray:
 
 
 def compute_node_levels(params: EnvParams, state: EnvState) -> jnp.ndarray:
-    """Active-subgraph topological generations (completed stages
-    excluded). Computed once per observation rather than incrementally
-    per event: a 20-deep dependent-op chain inside the event while-loop
-    was pure latency on TPU."""
-    active = state.stage_exists & ~state.stage_completed
+    """Active-subgraph topological generations (completed stages and
+    inactive jobs excluded — the same node set as the observation's
+    `node_mask`, so an Observation rebuilt from a stored rollout step is
+    bit-identical to the live one). Computed once per observation rather
+    than incrementally per event: a 20-deep dependent-op chain inside the
+    event while-loop was pure latency on TPU."""
+    active = (
+        state.job_active[:, None]
+        & state.stage_exists
+        & ~state.stage_completed
+    )
     adj_act = state.adj & active[:, :, None] & active[:, None, :]
     return topo_levels(active, adj_act)
 

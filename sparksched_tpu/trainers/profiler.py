@@ -20,9 +20,10 @@ class Profiler:
     """
 
     def __init__(self, trace_dir: str | None = None,
-                 label: str = "block") -> None:
+                 label: str = "block", quiet: bool = False) -> None:
         self.trace_dir = trace_dir
         self.label = label
+        self.quiet = quiet
         self.elapsed = 0.0
 
     def __enter__(self) -> "Profiler":
@@ -39,4 +40,8 @@ class Profiler:
             import jax
 
             jax.profiler.stop_trace()
-        print(f"[profiler] {self.label}: {self.elapsed:.3f}s", flush=True)
+        if not self.quiet:
+            print(
+                f"[profiler] {self.label}: {self.elapsed:.3f}s",
+                flush=True,
+            )

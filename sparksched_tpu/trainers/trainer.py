@@ -38,6 +38,7 @@ from ..env import core
 from ..schedulers import TrainableScheduler, make_scheduler
 from ..workload import make_workload_bank
 from .baselines import group_baselines
+from .profiler import Profiler
 from .returns import (
     AvgNumJobsBuffer,
     differential_returns,
@@ -97,6 +98,13 @@ class Trainer(abc.ABC):
         rd = train_cfg.get("rollout_duration")
         # YAML exponent literals without a sign ("2.0e7") arrive as strings
         self.rollout_duration = float(rd) if rd is not None else None
+
+        # per-iteration wall-time reporting + optional device trace of the
+        # first iteration (the reference wraps every rollout in cProfile,
+        # rollout_worker.py:103; host profiles are meaningless for jitted
+        # programs, so this uses the jax.profiler-backed Profiler)
+        self.profiling: bool = bool(train_cfg.get("profiling", False))
+        self.profile_trace_dir = train_cfg.get("profile_trace_dir")
 
         # exactly one returns mode (reference trainer.py:63-74)
         assert ("reward_buff_cap" in train_cfg) ^ (
@@ -286,11 +294,21 @@ class Trainer(abc.ABC):
             state = state.replace(
                 rng=jax.random.fold_in(jax.random.PRNGKey(self.seed), i)
             )
-            ro, self._env_states = self._collect_jit(
-                state.params, state.iteration, state.rng, self._env_states
+            trace_dir = (
+                self.profile_trace_dir if i == start else None
             )
+            with Profiler(trace_dir, f"iter {i + 1} collect",
+                          quiet=not self.profiling) as p_col:
+                ro, self._env_states = self._collect_jit(
+                    state.params, state.iteration, state.rng,
+                    self._env_states,
+                )
+                jax.block_until_ready(ro.reward)
             prev_params = state.params
-            state, stats = self._update_jit(state, ro)
+            with Profiler(None, f"iter {i + 1} update",
+                          quiet=not self.profiling) as p_upd:
+                state, stats = self._update_jit(state, ro)
+                jax.block_until_ready(state.params)
             state = state.replace(iteration=state.iteration + 1)
 
             roll_stats = self._rollout_stats(ro)
@@ -315,6 +333,8 @@ class Trainer(abc.ABC):
                 k: float(v) for k, v in stats.items()
                 if v is not None and k != "avg_num_jobs_est"
             }
+            host_stats["collect_seconds"] = p_col.elapsed
+            host_stats["update_seconds"] = p_upd.elapsed
             self._write_stats(i, host_stats | roll_stats)
             print(
                 f"Iteration {i + 1} complete. Avg. # jobs: "

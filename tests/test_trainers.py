@@ -375,3 +375,59 @@ def test_collect_async_group_shares_sequences_across_resets():
         np.asarray(ros[0].final_state.job_arrival_time),
     )
     assert not (same and same_arrivals)
+
+
+def test_stored_observation_roundtrip_is_exact():
+    """An Observation rebuilt from a StoredObs must match the live one
+    field-for-field on everything the models read (incl. the recomputed
+    node_level) — else PPO's epoch-0 importance ratio drifts from 1."""
+    import jax
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.env.observe import observe
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+    from sparksched_tpu.trainers.rollout import (
+        store_obs,
+        stored_to_observation,
+    )
+    from sparksched_tpu.workload import make_workload_bank
+
+    params = EnvParams(
+        num_executors=4, max_jobs=5, max_stages=20, max_levels=20,
+        moving_delay=500.0, warmup_delay=200.0,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    state = core.reset(params, bank, jax.random.PRNGKey(2))
+    checked = 0
+    for i in range(300):
+        live = observe(params, state)
+        rebuilt = stored_to_observation(bank, store_obs(live, state))
+        for name in ("nodes", "node_mask", "job_mask", "schedulable",
+                     "node_level", "exec_supplies",
+                     "num_committable", "source_job"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(rebuilt, name)),
+                np.asarray(getattr(live, name)),
+                err_msg=f"{name} differs at step {i}",
+            )
+        # obs.adj is raw template adjacency on the live path (consumers
+        # mask it — observe.py field note); compare the model-visible
+        # masked form
+        nm = np.asarray(live.node_mask)
+        live_adj = (
+            np.asarray(live.adj) & nm[:, :, None] & nm[:, None, :]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(rebuilt.adj), live_adj,
+            err_msg=f"masked adj differs at step {i}",
+        )
+        checked += 1
+        si, ne = round_robin_policy(live, params.num_executors, True)
+        state, _, term, trunc = core.step(params, bank, state, si, ne)
+        if bool(term) or bool(trunc):
+            break
+    assert checked > 30
