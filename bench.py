@@ -13,13 +13,24 @@ BASELINE.json.
 Engine: the flat micro-step loop (env/flat_loop.py) — every lane advances
 by one unit of work (decide / fulfill / event) per iteration, so no lane
 pays the batch-max event count of the per-decision `core.step` while_loop
-(the ~6x straggler tax measured in flat_loop.py's docstring). Each scan
-group is one full micro-step plus `BURST - 1` event-only sub-steps
-(`event_micro_step`): >90% of steady-state micro-steps are events, so the
-policy/observe/argsort cost of the DECIDE branch — which a batched
-`lax.switch` pays on every lane regardless of mode — is amortized BURST x.
-Episodes auto-reset in place so every lane stays busy (steady-state
-throughput).
+(the ~6x straggler tax measured in flat_loop.py's docstring). Two further
+measured optimizations (scripts_tail_probe.py / scripts_burst_sweep.py on
+the v5e, 2026-07-30):
+
+- bulk relaunch (`core._bulk_relaunch`): one EVENT micro-step consumes a
+  whole run of task-relaunch events — the dominant event kind — instead
+  of one, cutting micro-steps per decision several-fold;
+- reset hoisting: `core.reset` (a full arrival-sequence resample) plus
+  the fresh/old tree-select cost 2.7 of the 6.7 ms per 1024-lane
+  micro-step when auto-reset runs inside the loop. Chunks run with
+  auto_reset=False (done lanes freeze, episodes last thousands of
+  micro-steps so the idle tail is <~2%) and done lanes are re-seeded
+  between timed chunks by `reset_done_lanes`.
+
+`BURST - 1` event-only sub-steps per group are still supported but
+default to off: with bulk relaunches the event/decide imbalance the burst
+amortized is mostly gone, and the sweep showed lanes stalled in
+non-EVENT modes during bursts cost more than the amortization saved.
 """
 
 from __future__ import annotations
@@ -48,7 +59,7 @@ NUM_ENVS = 1024
 SUB_BATCH = int(os.environ.get("BENCH_SUB_BATCH", 512))
 # the tunnel also kills device programs that run for tens of seconds, so
 # keep each timed program short and accumulate across calls
-BURST = int(os.environ.get("BENCH_BURST", 8))  # event sub-steps per group
+BURST = int(os.environ.get("BENCH_BURST", 1))  # event sub-steps per group
 MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
 assert NUM_ENVS % SUB_BATCH == 0, (
     f"BENCH_SUB_BATCH={SUB_BATCH} must divide {NUM_ENVS}"
@@ -72,7 +83,8 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs):
     def lane(ls, rng):
         return run_flat(
             params, bank, pol, rng, MICRO_CHUNK // BURST,
-            compute_levels=False, event_burst=BURST, loop_state=ls,
+            auto_reset=False, compute_levels=False, event_burst=BURST,
+            loop_state=ls,
         )
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
@@ -88,6 +100,30 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs):
         lambda a: a.reshape(b, *a.shape[2:]), loop_states
     )
     return loop_states, loop_states.decisions.sum()
+
+
+@partial(jax.jit, static_argnums=(0,))
+def reset_done_lanes(params: EnvParams, bank, loop_states, keys):
+    """Re-seed finished lanes between timed chunks (reset hoisting: see
+    module docstring). Counters persist; only env/loop mode restart."""
+    fresh_env = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
+    fresh = jax.vmap(init_loop_state)(fresh_env)
+    fresh = fresh.replace(
+        decisions=loop_states.decisions,
+        episodes=loop_states.episodes,
+        bulked=loop_states.bulked,
+    )
+    done = (
+        jax.vmap(lambda e: e.all_jobs_complete)(loop_states.env)
+        | (loop_states.env.wall_time >= loop_states.env.time_limit)
+    )
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(
+            done.reshape(done.shape + (1,) * (a.ndim - 1)), a, b
+        ),
+        fresh,
+        loop_states,
+    )
 
 
 def main() -> None:
@@ -115,12 +151,20 @@ def main() -> None:
     # warmup/compile
     keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
     loop_states, n = bench_chunk(params, bank, loop_states, keys)
+    loop_states = reset_done_lanes(
+        params, bank, loop_states,
+        jax.random.split(jax.random.PRNGKey(101), NUM_ENVS),
+    )
     base = int(jax.block_until_ready(n))
 
     t0 = time.perf_counter()
     for i in range(NUM_CHUNKS):
         keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
         loop_states, n = bench_chunk(params, bank, loop_states, keys)
+        loop_states = reset_done_lanes(
+            params, bank, loop_states,
+            jax.random.split(jax.random.PRNGKey(102 + i), NUM_ENVS),
+        )
         total = int(jax.block_until_ready(n))
     dt = time.perf_counter() - t0
 

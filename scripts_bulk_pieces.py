@@ -1,0 +1,183 @@
+"""Isolate which construct inside _bulk_relaunch costs the time on the
+real chip: scan 256 iterations of successively larger prefixes of the
+bulk computation over 1024 lanes (512-lane sub-batches) and time each.
+
+Scratch diagnostic for the round-2 perf push (not part of the package).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sparksched_tpu.config import (
+    EnvParams,
+    enable_compilation_cache,
+    honor_jax_platforms_env,
+)
+from sparksched_tpu.env import core
+from sparksched_tpu.env.state import BIG_SEQ, INF
+from sparksched_tpu.workload import make_workload_bank
+from sparksched_tpu.workload.sampling import sample_task_duration
+
+NUM_ENVS, SUB, CHUNK = 1024, 512, 256
+_i32 = jnp.int32
+
+
+def bulk_upto(params, bank, state, level: int):
+    """Prefixes of _bulk_relaunch's computation; returns a scalar that
+    depends on everything computed so far (keeps XLA from DCE'ing)."""
+    n = state.exec_finish_time.shape[0]
+    j_cap, s_cap = state.stage_remaining.shape
+    pos = jnp.arange(n)
+    acc = state.wall_time
+
+    if level >= 1:  # competitors + lexsort + permute
+        t_job = jnp.where(state.job_arrived, INF, state.job_arrival_time)
+        jt = t_job.min()
+        jseq = jnp.where(t_job == jt, state.job_arrival_seq, BIG_SEQ).min()
+        at = state.exec_arrive_time.min()
+        aseq = jnp.where(
+            state.exec_arrive_time == at, state.exec_arrive_seq, BIG_SEQ
+        ).min()
+        t_star = jnp.minimum(jt, at)
+        seq_star = jnp.minimum(
+            jnp.where(jt == t_star, jseq, BIG_SEQ),
+            jnp.where(at == t_star, aseq, BIG_SEQ),
+        )
+        order = jnp.lexsort((state.exec_finish_seq, state.exec_finish_time))
+        to = state.exec_finish_time[order]
+        so = state.exec_finish_seq[order]
+        js = state.exec_job[order]
+        ss = state.exec_task_stage[order]
+        acc = acc + to.sum() + (so + js + ss).sum()
+    if level >= 2:  # per-candidate gathers
+        rem0 = state.stage_remaining[
+            jnp.clip(js, 0, j_cap - 1), jnp.clip(ss, 0, s_cap - 1)
+        ]
+        num_local = (state.exec_job[None, :] == js[:, None]).sum(-1)
+        tpl = state.job_template[jnp.clip(js, 0, j_cap - 1)]
+        acc = acc + (rem0 + num_local + tpl).sum()
+    if level >= 3:  # rng keys + vmapped sampler
+        rng_next, sub = jax.random.split(state.rng)
+        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(sub, pos)
+        durs = jax.vmap(
+            lambda key, tp, s_, nl: sample_task_duration(
+                params, bank, key, tp, s_, nl,
+                jnp.bool_(True), jnp.bool_(True),
+            )
+        )(keys, tpl, jnp.clip(ss, 0, s_cap - 1), num_local)
+        acc = acc + durs.sum() + rng_next.sum()
+    else:
+        durs = to * 0.5
+    if level >= 4:  # prefix conditions
+        new_fin = to + durs
+        flat = js * s_cap + ss
+        earlier = pos[None, :] < pos[:, None]
+        cum_before = (earlier & (flat[None, :] == flat[:, None])).sum(-1)
+        before_star = (to < t_star) | ((to == t_star) & (so < seq_star))
+        gen_before = jnp.concatenate(
+            [jnp.full((1,), INF), lax.cummin(new_fin)[:-1]]
+        )
+        ok = (
+            jnp.isfinite(to) & before_star
+            & (cum_before < rem0) & (to <= gen_before)
+        )
+        prefix = jnp.cumsum((~ok).astype(_i32)) == 0
+        k = prefix.sum().astype(_i32)
+        acc = acc + k
+    if level >= 5:  # executor selects
+        new_seq = state.seq_counter + pos
+        sel = prefix[:, None] & (order[:, None] == pos[None, :])
+        upd_e = sel.any(0)
+        fin_e = jnp.where(sel, new_fin[:, None], 0.0).sum(0)
+        seq_e = jnp.where(sel, new_seq[:, None], 0).sum(0)
+        acc = acc + jnp.where(upd_e, fin_e, 0.0).sum() + seq_e.sum()
+    if level >= 6:  # [N,J,S] stage masks + reductions
+        m = (
+            (js[:, None] == jnp.arange(j_cap)[None, :])[:, :, None]
+            & (ss[:, None] == jnp.arange(s_cap)[None, :])[:, None, :]
+            & prefix[:, None, None]
+        )
+        cnt = m.sum(0).astype(_i32)
+        last_pos = jnp.where(m, pos[:, None, None] + 1, 0).max(0)
+        dur_js = durs[jnp.maximum(last_pos - 1, 0)]
+        acc = acc + cnt.sum() + jnp.where(last_pos > 0, dur_js, 0.0).sum()
+    if level >= 7:  # sat refresh + children reduce
+        rem_new = state.stage_remaining - cnt
+        aff = cnt > 0
+        demand = rem_new - state.moving_count - state.commit_count
+        sat_new = demand <= 0
+        delta = jnp.where(
+            aff & state.stage_exists,
+            sat_new.astype(_i32) - state.stage_sat.astype(_i32),
+            0,
+        )
+        unsat = state.unsat_parent_count - (
+            delta[:, :, None] * state.adj.astype(_i32)
+        ).sum(axis=1)
+        acc = acc + unsat.sum() + sat_new.sum()
+    return acc
+
+
+def main(levels) -> None:
+    params = EnvParams(
+        num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
+        moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
+        mean_time_limit=None,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    if bank.max_stages != params.max_stages:
+        params = params.replace(
+            max_stages=bank.max_stages, max_levels=bank.max_stages
+        )
+
+    @partial(jax.jit, static_argnums=(0,))
+    def chunk(level, states, accs):
+        def lane(state, acc):
+            def body(a, _):
+                return a + bulk_upto(params, bank, state, level), None
+
+            out, _ = lax.scan(body, acc, None, length=CHUNK)
+            return out
+
+        grp = jax.tree_util.tree_map(
+            lambda a: a.reshape(NUM_ENVS // SUB, SUB, *a.shape[1:]),
+            (states, accs),
+        )
+        return lax.map(
+            lambda sr: jax.vmap(lane)(sr[0], sr[1]), grp
+        ).reshape(NUM_ENVS)
+
+    rng = jax.random.PRNGKey(0)
+    states = jax.vmap(lambda k: core.reset(params, bank, k))(
+        jax.random.split(rng, NUM_ENVS)
+    )
+    accs = jnp.zeros(NUM_ENVS)
+    prev = 0.0
+    for level in levels:
+        out = chunk(level, states, accs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = chunk(level, states, out)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        per = dt / (3 * CHUNK) * 1e3
+        print(
+            f"level={level}: {per:6.3f} ms per 1024-lane iter "
+            f"(delta {per - prev:+6.3f})"
+        )
+        prev = per
+
+
+if __name__ == "__main__":
+    honor_jax_platforms_env()
+    enable_compilation_cache()
+    lv = [int(x) for x in sys.argv[1:]] or [0, 1, 2, 3, 4, 5, 6, 7]
+    main(lv)

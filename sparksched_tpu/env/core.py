@@ -697,31 +697,221 @@ def _next_event(params: EnvParams, state: EnvState):
     return has, tmin, kind, arg
 
 
+def _bulk_relaunch(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    enabled: jnp.ndarray, stop_at_limit: bool = False,
+):
+    """Pop the maximal run of consecutive *task relaunch* events in one
+    vectorized pass. Returns (state, k) with k the number of events
+    consumed (0 when the next event is not a relaunch, the queue is
+    drained, or `enabled` is False — callers fall back to the
+    single-event path).
+
+    A relaunch is a TASK_FINISHED event on a stage that still has
+    unlaunched tasks at processing time (`stage_remaining > 0`): the
+    executor immediately launches the stage's next task
+    (`_handle_task_finished`'s more_tasks path resolving to A_START).
+    These are by far the most common events (one per task, 100s per
+    stage), and a run of them is order-equivalent to popping one by one:
+
+    - the source pool is always empty when events are being popped
+      (`clear_round`/`move_and_clear` precede every pop), so
+      `num_committable() == 0` and `round_ready` cannot flip mid-run
+      even when a relaunch saturates a parent stage and readies its
+      children;
+    - relaunches touch no pools, commitments, sources or frontiers —
+      only per-executor finish times/seqs and per-stage counters, whose
+      sequential updates commute into per-stage sums;
+    - each event's duration draw uses its own rng key, so the batched
+      draw matches the sequential distribution (streams differ — the
+      engine makes no bit-exactness promise for stochastic banks).
+
+    The run stops before the first event that is not a relaunch in its
+    processing order: a non-finish event with an earlier (time, seq), or
+    a finish on a stage whose unlaunched tasks the run has exhausted.
+    With `stop_at_limit` (the flat engine's per-micro-step episode-end
+    check) the run also stops just after the first event at or past the
+    episode time limit, which is where that engine freezes/resets.
+    """
+    n = state.exec_finish_time.shape[0]
+    j_cap, s_cap = state.stage_remaining.shape
+    pos = jnp.arange(n)
+
+    # earliest non-finish competitor, lexicographic (time, seq)
+    t_job = jnp.where(state.job_arrived, INF, state.job_arrival_time)
+    jt = t_job.min()
+    jseq = jnp.where(t_job == jt, state.job_arrival_seq, BIG_SEQ).min()
+    at = state.exec_arrive_time.min()
+    aseq = jnp.where(
+        state.exec_arrive_time == at, state.exec_arrive_seq, BIG_SEQ
+    ).min()
+    t_star = jnp.minimum(jt, at)
+    seq_star = jnp.minimum(
+        jnp.where(jt == t_star, jseq, BIG_SEQ),
+        jnp.where(at == t_star, aseq, BIG_SEQ),
+    )
+
+    # executors sorted by (finish_time, finish_seq) = processing order
+    order = jnp.lexsort((state.exec_finish_seq, state.exec_finish_time))
+    to = state.exec_finish_time[order]
+    so = state.exec_finish_seq[order]
+    js = state.exec_job[order]
+    ss = state.exec_task_stage[order]
+
+    # durations are sampled for every candidate up front (one independent
+    # key per event — order along the run is immaterial, see docstring;
+    # rng advances once iff the bulk fires) because the prefix condition
+    # below needs the *generated* event times
+    rng_next, sub = jax.random.split(state.rng)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(sub, pos)
+    num_local = (state.exec_job[None, :] == js[:, None]).sum(-1)
+    tpl = state.job_template[jnp.clip(js, 0, j_cap - 1)]
+    durs = jax.vmap(
+        lambda key, tp, s_, nl: sample_task_duration(
+            params, bank, key, tp, s_, nl,
+            jnp.bool_(True), jnp.bool_(True),
+        )
+    )(keys, tpl, jnp.clip(ss, 0, s_cap - 1), num_local)
+    new_fin = to + durs
+
+    # maximal prefix of relaunches: position i qualifies iff
+    # - its event precedes every pending non-finish event,
+    # - the i earlier launches leave its stage with unlaunched tasks
+    #   (the handler's remaining > 0), and
+    # - no earlier relaunch in the run GENERATED an event that precedes
+    #   it: a relaunch pushes a new finish at t_j + dur_j, which the
+    #   sequential loop would pop before any later-timed candidate (ties
+    #   go to the pending event — generated seqs are larger)
+    flat = js * s_cap + ss
+    earlier = pos[None, :] < pos[:, None]
+    cum_before = (earlier & (flat[None, :] == flat[:, None])).sum(-1)
+    rem0 = state.stage_remaining[
+        jnp.clip(js, 0, j_cap - 1), jnp.clip(ss, 0, s_cap - 1)
+    ]
+    before_star = (to < t_star) | ((to == t_star) & (so < seq_star))
+    gen_before = jnp.concatenate(
+        [jnp.full((1,), INF), lax.cummin(new_fin)[:-1]]
+    )
+    ok = (
+        jnp.isfinite(to)
+        & before_star
+        & (cum_before < rem0)
+        & (to <= gen_before)
+    )
+    if stop_at_limit:
+        crossed_before = (
+            jnp.concatenate(
+                [jnp.zeros(1, bool), (to >= state.time_limit)[:-1]]
+            ).cumsum() > 0
+        )
+        ok &= ~crossed_before
+    prefix = (jnp.cumsum((~ok).astype(_i32)) == 0) & enabled
+    k = prefix.sum().astype(_i32)
+
+    # per-executor: new finish event at t_i + dur_i with seq = counter + i
+    new_seq = state.seq_counter + pos
+    sel = prefix[:, None] & (order[:, None] == pos[None, :])  # [i, e]
+    upd_e = sel.any(0)
+    fin_e = jnp.where(sel, new_fin[:, None], 0.0).sum(0)
+    seq_e = jnp.where(sel, new_seq[:, None], 0).sum(0)
+
+    # per-stage: launch counts, last-writer duration, task-exhaustion
+    m = (
+        (js[:, None] == jnp.arange(j_cap)[None, :])[:, :, None]
+        & (ss[:, None] == jnp.arange(s_cap)[None, :])[:, None, :]
+        & prefix[:, None, None]
+    )  # [N, J, S]
+    cnt = m.sum(0).astype(_i32)
+    aff = cnt > 0
+    rem_new = state.stage_remaining - cnt
+    exhausted = aff & (cnt == state.stage_remaining)
+    last_pos = jnp.where(m, pos[:, None, None] + 1, 0).max(0)
+    dur_js = durs[jnp.maximum(last_pos - 1, 0)]
+    stage_duration = jnp.where(
+        last_pos > 0, dur_js, state.stage_duration
+    )
+
+    # saturation-cache refresh for every touched stage (_refresh_sat
+    # semantics, batched: demand fell monotonically, one net flip max)
+    demand = rem_new - state.moving_count - state.commit_count
+    sat_new = demand <= 0
+    delta = jnp.where(
+        aff & state.stage_exists,
+        sat_new.astype(_i32) - state.stage_sat.astype(_i32),
+        0,
+    )
+    # children update as broadcast-multiply-reduce, NOT einsum: a
+    # "js,jsc->jc" contraction lowers to J tiny [1,S]x[S,S] integer
+    # matmuls per lane — padded to MXU tiles they cost ~mllisecond-scale
+    # per micro-step on TPU, while this elementwise form is a single
+    # fused reduce
+    unsat = state.unsat_parent_count - (
+        delta[:, :, None] * state.adj.astype(_i32)
+    ).sum(axis=1)
+
+    wall = jnp.where(
+        k > 0, jnp.where(prefix, to, -INF).max(), state.wall_time
+    )
+    bulked = k > 0
+    return state.replace(
+        rng=jnp.where(bulked, rng_next, state.rng),
+        wall_time=wall,
+        seq_counter=state.seq_counter + k,
+        exec_finish_time=jnp.where(
+            upd_e, fin_e, state.exec_finish_time
+        ),
+        exec_finish_seq=jnp.where(upd_e, seq_e, state.exec_finish_seq),
+        stage_remaining=rem_new,
+        stage_completed_tasks=state.stage_completed_tasks + cnt,
+        stage_duration=stage_duration,
+        job_saturated_stages=state.job_saturated_stages
+        + exhausted.sum(-1).astype(_i32),
+        stage_sat=jnp.where(aff, sat_new, state.stage_sat),
+        unsat_parent_count=unsat,
+    ), k
+
+
 def _resume_simulation(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
-    active: jnp.ndarray
+    active: jnp.ndarray, bulk: bool = True
 ) -> EnvState:
     """Pop events until there are new scheduling decisions to make or the
-    queue drains (reference :320-343). `active` masks the whole loop."""
+    queue drains (reference :320-343). `active` masks the whole loop.
+    With `bulk`, each iteration first consumes a whole run of relaunch
+    events via `_bulk_relaunch` and only falls back to the single-event
+    path when the next event is something else."""
 
     def cond(st: EnvState) -> jnp.ndarray:
         has, _, _, _ = _next_event(params, st)
         return active & has & ~st.round_ready
 
     def body(st: EnvState) -> EnvState:
+        if bulk:
+            st, nb = _bulk_relaunch(params, bank, st, jnp.bool_(True))
+            single = nb == 0
+        else:
+            single = jnp.bool_(True)
         _, t, kind, arg = _next_event(params, st)
-        st = st.replace(wall_time=t)
-        quirk_src = st.source_job_id()
-        st, rk, rj, rs = lax.switch(
-            kind,
-            [
-                lambda st, a: _handle_job_arrival(st, a),
-                lambda st, a: _handle_task_finished(st, a),
-                lambda st, a: _handle_executor_ready(st, a),
-            ],
-            st,
-            arg,
-        )
+
+        def pop(st: EnvState):
+            st = st.replace(wall_time=t)
+            quirk_src = st.source_job_id()
+            st, rk, rj, rs = lax.switch(
+                kind,
+                [
+                    lambda st, a: _handle_job_arrival(st, a),
+                    lambda st, a: _handle_task_finished(st, a),
+                    lambda st, a: _handle_executor_ready(st, a),
+                ],
+                st,
+                arg,
+            )
+            return st, rk, rj, rs, quirk_src
+
+        def nopop(st: EnvState):
+            return st, _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(-1)
+
+        st, rk, rj, rs, quirk_src = lax.cond(single, pop, nopop, st)
         ak, tj, ts = _resolve_action(params, st, rk, arg, rj, rs, quirk_src)
         st = _apply_action(params, bank, st, ak, arg, tj, ts)
         committable = st.num_committable()
@@ -872,13 +1062,15 @@ def reset_from_sequence(
     return state.replace(schedulable=sched, round_ready=jnp.bool_(True))
 
 
-@partial(jax.jit, static_argnums=0)
+@partial(jax.jit, static_argnums=0, static_argnames=("bulk",))
 def step(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
-    stage_idx: jnp.ndarray, num_exec: jnp.ndarray
+    stage_idx: jnp.ndarray, num_exec: jnp.ndarray, *, bulk: bool = True
 ):
     """One decision step (reference :188-221). Returns
-    (state, reward, terminated, truncated)."""
+    (state, reward, terminated, truncated). `bulk=False` forces the
+    event loop onto the one-event-per-iteration path (equivalence
+    testing; see `_bulk_relaunch`)."""
     s_cap = params.max_stages
     j = stage_idx // s_cap
     s = stage_idx % s_cap
@@ -928,7 +1120,7 @@ def step(
     state = lax.cond(active, clear_round, lambda st: st, state)
     t_old = state.wall_time
     active_old = state.job_active
-    state = _resume_simulation(params, bank, state, active)
+    state = _resume_simulation(params, bank, state, active, bulk=bulk)
     reward = jnp.where(
         active, -_compute_jobtime(params, state, t_old, active_old), 0.0
     )

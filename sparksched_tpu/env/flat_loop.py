@@ -36,6 +36,7 @@ from .core import (
     _onehot2,
     _add_commitment,
     _apply_action,
+    _bulk_relaunch,
     _commit_remaining,
     _fulfill_commitment_phase_a,
     _handle_executor_ready,
@@ -63,6 +64,7 @@ class LoopState(struct.PyTreeNode):
     slot_order: jnp.ndarray  # i32[N]
     decisions: jnp.ndarray  # i32 []; decision micro-steps taken
     episodes: jnp.ndarray  # i32 []; completed episodes
+    bulked: jnp.ndarray  # i32 []; events consumed by bulk relaunches
 
 
 def init_loop_state(state: EnvState) -> LoopState:
@@ -76,6 +78,7 @@ def init_loop_state(state: EnvState) -> LoopState:
         slot_order=jnp.zeros(n, _i32),
         decisions=_i32(0),
         episodes=_i32(0),
+        bulked=_i32(0),
     )
 
 
@@ -127,9 +130,23 @@ def micro_step(
     rng: jax.Array,
     auto_reset: bool = True,
     compute_levels: bool = True,
+    event_bulk: bool = True,
 ) -> LoopState:
-    """One unit of work for one lane (vmap over lanes)."""
+    """One unit of work for one lane (vmap over lanes). With
+    `event_bulk`, an EVENT micro-step consumes a whole run of relaunch
+    events via `core._bulk_relaunch` (hoisted above the mode switch —
+    it samples task durations, and bank accesses must stay out of
+    lane-dependent branches; see core's structural note) and only falls
+    back to the single-event pop when the run is empty."""
     k_pol, k_reset = jax.random.split(rng)
+    ls0 = ls  # pre-bulk state: the freeze path must restore exactly this
+    if event_bulk:
+        env_b, nb = _bulk_relaunch(
+            params, bank, ls.env, ls.mode == M_EVENT, stop_at_limit=True
+        )
+        ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
+    else:
+        nb = _i32(0)
     st = ls.env
     n = st.exec_job.shape[0]
     s_cap = params.max_stages
@@ -229,16 +246,17 @@ def micro_step(
         return ls.replace(env=st, mode=mode, fulfill_k=k + 1), rk, rj, rs, \
             e, quirk
 
-    # ---- EVENT: one event pop + handling (core._resume_simulation body)
+    # ---- EVENT: one event pop + handling (core._resume_simulation body);
+    # no-op when the bulk pass above already consumed this step's events
     def event(ls: LoopState):
-        st, rk, rj, rs, arg, quirk = _pop_event(params, ls.env, True)
+        st, rk, rj, rs, arg, quirk = _pop_event(params, ls.env, nb == 0)
         return ls.replace(env=st), rk, rj, rs, arg, quirk
 
     ls2, rk, rj, rs, e, quirk = lax.switch(
         ls.mode, [decide, fulfill, event], ls
     )
     return _finish_micro_step(
-        params, bank, ls, ls2, rk, rj, rs, e, quirk, k_reset, auto_reset
+        params, bank, ls0, ls2, rk, rj, rs, e, quirk, k_reset, auto_reset
     )
 
 
@@ -324,7 +342,10 @@ def _finish_micro_step(
         ls2 = ls2.replace(
             decisions=jnp.where(
                 was_done, ls.decisions, ls2.decisions
-            ).astype(_i32)
+            ).astype(_i32),
+            bulked=jnp.where(
+                was_done, ls.bulked, ls2.bulked
+            ).astype(_i32),
         )
     return ls2.replace(
         env=st,
@@ -339,6 +360,7 @@ def event_micro_step(
     ls: LoopState,
     rng: jax.Array,
     auto_reset: bool = True,
+    event_bulk: bool = True,
 ) -> LoopState:
     """One EVENT-only micro-step: lanes in M_EVENT mode pop + handle one
     event (with the full shared tail); other lanes no-op.
@@ -354,10 +376,19 @@ def event_micro_step(
     is_event = ls.mode == M_EVENT
     _, k_reset = jax.random.split(rng)
 
-    st, rk, rj, rs, arg, quirk = _pop_event(params, ls.env, is_event)
+    ls0 = ls.replace(mode=_i32(M_EVENT))  # pre-bulk state for the tail
+    if event_bulk:
+        env_b, nb = _bulk_relaunch(
+            params, bank, ls.env, is_event, stop_at_limit=True
+        )
+        ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
+        pop_on = is_event & (nb == 0)
+    else:
+        pop_on = is_event
+    st, rk, rj, rs, arg, quirk = _pop_event(params, ls.env, pop_on)
     ls_ev = ls.replace(mode=_i32(M_EVENT), env=st)
     out = _finish_micro_step(
-        params, bank, ls.replace(mode=_i32(M_EVENT)), ls_ev,
+        params, bank, ls0, ls_ev,
         rk, rj, rs, arg, quirk, k_reset, auto_reset,
     )
     # non-event lanes are untouched (their rng/state must not advance)
@@ -376,6 +407,7 @@ def run_flat(
     auto_reset: bool = True,
     compute_levels: bool = True,
     event_burst: int = 1,
+    event_bulk: bool = True,
     loop_state: LoopState | None = None,
 ) -> LoopState:
     """Scan `num_groups` micro-step groups for one lane (vmap over
@@ -391,11 +423,13 @@ def run_flat(
         k, sub = jax.random.split(k)
         ls = micro_step(
             params, bank, policy_fn, ls, sub, auto_reset,
-            compute_levels,
+            compute_levels, event_bulk,
         )
         for _ in range(event_burst - 1):
             k, sub = jax.random.split(k)
-            ls = event_micro_step(params, bank, ls, sub, auto_reset)
+            ls = event_micro_step(
+                params, bank, ls, sub, auto_reset, event_bulk
+            )
         return (ls, k), None
 
     (ls, _), _ = lax.scan(body, (ls, rng), None, length=num_groups)
