@@ -188,8 +188,11 @@ if __name__ == "__main__":
     from sparksched_tpu.config import (
         enable_compilation_cache,
         honor_jax_platforms_env,
+        use_fast_prng,
     )
 
     honor_jax_platforms_env()
     enable_compilation_cache()
+    if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+        use_fast_prng()
     main()

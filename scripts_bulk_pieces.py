@@ -37,7 +37,7 @@ def bulk_upto(params, bank, state, level: int):
     pos = jnp.arange(n)
     acc = state.wall_time
 
-    if level >= 1:  # competitors + lexsort + permute
+    if level >= 1:  # competitors + pairwise-rank permutation
         t_job = jnp.where(state.job_arrived, INF, state.job_arrival_time)
         jt = t_job.min()
         jseq = jnp.where(t_job == jt, state.job_arrival_seq, BIG_SEQ).min()
@@ -50,11 +50,21 @@ def bulk_upto(params, bank, state, level: int):
             jnp.where(jt == t_star, jseq, BIG_SEQ),
             jnp.where(at == t_star, aseq, BIG_SEQ),
         )
-        order = jnp.lexsort((state.exec_finish_seq, state.exec_finish_time))
-        to = state.exec_finish_time[order]
-        so = state.exec_finish_seq[order]
-        js = state.exec_job[order]
-        ss = state.exec_task_stage[order]
+        tf = state.exec_finish_time
+        sf = state.exec_finish_seq
+        gt = (tf[:, None] > tf[None, :]) | (
+            (tf[:, None] == tf[None, :]) & (sf[:, None] > sf[None, :])
+        )
+        rank = gt.sum(-1)
+        perm = rank[None, :] == pos[:, None]
+
+        def by_pos(x):
+            return jnp.where(perm, x[None, :], 0).sum(-1)
+
+        to = jnp.where(perm, tf[None, :], INF).min(-1)
+        so = by_pos(sf)
+        js = by_pos(state.exec_job)
+        ss = by_pos(state.exec_task_stage)
         acc = acc + to.sum() + (so + js + ss).sum()
     if level >= 2:  # per-candidate gathers
         rem0 = state.stage_remaining[
@@ -73,8 +83,6 @@ def bulk_upto(params, bank, state, level: int):
             )
         )(keys, tpl, jnp.clip(ss, 0, s_cap - 1), num_local)
         acc = acc + durs.sum() + rng_next.sum()
-    else:
-        durs = to * 0.5
     if level >= 4:  # prefix conditions
         new_fin = to + durs
         flat = js * s_cap + ss
@@ -93,34 +101,43 @@ def bulk_upto(params, bank, state, level: int):
         acc = acc + k
     if level >= 5:  # executor selects
         new_seq = state.seq_counter + pos
-        sel = prefix[:, None] & (order[:, None] == pos[None, :])
+        sel = prefix[:, None] & perm
         upd_e = sel.any(0)
         fin_e = jnp.where(sel, new_fin[:, None], 0.0).sum(0)
         seq_e = jnp.where(sel, new_seq[:, None], 0).sum(0)
         acc = acc + jnp.where(upd_e, fin_e, 0.0).sum() + seq_e.sum()
-    if level >= 6:  # [N,J,S] stage masks + reductions
-        m = (
-            (js[:, None] == jnp.arange(j_cap)[None, :])[:, :, None]
-            & (ss[:, None] == jnp.arange(s_cap)[None, :])[:, None, :]
-            & prefix[:, None, None]
-        )
+    if level >= 6:  # [N,J,S] stage masks + payload reductions
+        oh_j = js[:, None] == jnp.arange(j_cap)[None, :]
+        oh_s = ss[:, None] == jnp.arange(s_cap)[None, :]
+        m = oh_j[:, :, None] & oh_s[:, None, :] & prefix[:, None, None]
         cnt = m.sum(0).astype(_i32)
-        last_pos = jnp.where(m, pos[:, None, None] + 1, 0).max(0)
-        dur_js = durs[jnp.maximum(last_pos - 1, 0)]
-        acc = acc + cnt.sum() + jnp.where(last_pos > 0, dur_js, 0.0).sum()
-    if level >= 7:  # sat refresh + children reduce
-        rem_new = state.stage_remaining - cnt
         aff = cnt > 0
+        later_same = (
+            (flat[None, :] == flat[:, None])
+            & (pos[None, :] > pos[:, None])
+            & prefix[None, :]
+        )
+        is_last = prefix & ~later_same.any(-1)
+        dur_js = (m & is_last[:, None, None]).astype(durs.dtype)
+        sd = jnp.where(aff, (dur_js * durs[:, None, None]).sum(0), 0.0)
+        acc = acc + cnt.sum() + sd.sum()
+    if level >= 7:  # sat refresh + candidate-row children update
+        rem_new = state.stage_remaining - cnt
         demand = rem_new - state.moving_count - state.commit_count
         sat_new = demand <= 0
-        delta = jnp.where(
-            aff & state.stage_exists,
-            sat_new.astype(_i32) - state.stage_sat.astype(_i32),
+        jc = jnp.clip(js, 0, j_cap - 1)
+        sc = jnp.clip(ss, 0, s_cap - 1)
+        delta_i = jnp.where(
+            is_last & state.stage_exists[jc, sc],
+            sat_new[jc, sc].astype(_i32)
+            - state.stage_sat[jc, sc].astype(_i32),
             0,
         )
+        adj_row = state.adj[jc, sc]
         unsat = state.unsat_parent_count - (
-            delta[:, :, None] * state.adj.astype(_i32)
-        ).sum(axis=1)
+            oh_j[:, :, None]
+            * (delta_i[:, None] * adj_row.astype(_i32))[:, None, :]
+        ).sum(0)
         acc = acc + unsat.sum() + sat_new.sum()
     return acc
 
@@ -140,11 +157,25 @@ def main(levels) -> None:
     @partial(jax.jit, static_argnums=(0,))
     def chunk(level, states, accs):
         def lane(state, acc):
-            def body(a, _):
-                return a + bulk_upto(params, bank, state, level), None
+            def body(carry, _):
+                st, a = carry
+                a = a + bulk_upto(params, bank, st, level)
+                # perturb the bulk's inputs so nothing is loop-invariant
+                # (XLA hoists computations on constant carries out of
+                # the scan, which zeroed out a first version of this
+                # probe)
+                st = st.replace(
+                    exec_finish_time=st.exec_finish_time + (a * 0 + 1.0),
+                    stage_remaining=st.stage_remaining
+                    + (a * 0).astype(jnp.int32),
+                    rng=st.rng + (a * 0).astype(st.rng.dtype),
+                )
+                return (st, a), None
 
-            out, _ = lax.scan(body, acc, None, length=CHUNK)
-            return out
+            (st, out), _ = lax.scan(
+                body, (state, acc), None, length=CHUNK
+            )
+            return out + st.wall_time * 0
 
         grp = jax.tree_util.tree_map(
             lambda a: a.reshape(NUM_ENVS // SUB, SUB, *a.shape[1:]),

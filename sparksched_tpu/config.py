@@ -114,6 +114,26 @@ def honor_jax_platforms_env() -> None:
             jax.config.update("jax_platforms", plat)
 
 
+def use_fast_prng() -> None:
+    """Switch jax's default PRNG to the TPU-friendly ``rbg`` impl.
+
+    The default threefry generator unrolls to ~60 scalar-heavy HLO ops
+    per draw; the simulator's hot loop draws several keys per
+    micro-step (reset keys, task-duration samples), so on an op-count
+    bound engine the RNG alone is a measurable slice of every step
+    (jaxpr census: sample_task_duration is ~200 eqns, ~180 of them
+    threefry). ``rbg`` lowers to a single XLA RngBitGenerator op.
+
+    Trade-off: rbg's split/fold_in are statistically weaker than
+    threefry's, which is irrelevant for workload sampling. Keys from
+    the two impls are incompatible (uint32[4] vs uint32[2]), so a
+    checkpointed rng resumes only under the impl that wrote it. Tests
+    keep the default threefry."""
+    import jax
+
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+
 def enable_compilation_cache(path: str | None = None) -> None:
     """Persist XLA compilations across processes.
 

@@ -751,12 +751,28 @@ def _bulk_relaunch(
         jnp.where(at == t_star, aseq, BIG_SEQ),
     )
 
-    # executors sorted by (finish_time, finish_seq) = processing order
-    order = jnp.lexsort((state.exec_finish_seq, state.exec_finish_time))
-    to = state.exec_finish_time[order]
-    so = state.exec_finish_seq[order]
-    js = state.exec_job[order]
-    ss = state.exec_task_stage[order]
+    # executors sorted by (finish_time, finish_seq) = processing order.
+    # The permutation is computed as an N x N pairwise-comparison rank
+    # matrix rather than a lexsort + gathers: seqs are unique so ranks
+    # are a permutation, and the one-hot matrix P (P[r, i] = executor i
+    # sits at sorted position r) turns every "sort + gather" and the
+    # later position->executor scatter into masked reduces — no sort or
+    # gather primitives in the hot path.
+    tf = state.exec_finish_time
+    sf = state.exec_finish_seq
+    gt = (tf[:, None] > tf[None, :]) | (
+        (tf[:, None] == tf[None, :]) & (sf[:, None] > sf[None, :])
+    )
+    rank = gt.sum(-1)  # sorted position of executor i
+    perm = rank[None, :] == pos[:, None]  # [position, executor]
+
+    def by_pos(x):
+        return jnp.where(perm, x[None, :], 0).sum(-1)
+
+    to = jnp.where(perm, tf[None, :], INF).min(-1)
+    so = by_pos(sf)
+    js = by_pos(state.exec_job)
+    ss = by_pos(state.exec_task_stage)
 
     # durations are sampled for every candidate up front (one independent
     # key per event — order along the run is immaterial, see docstring;
@@ -765,13 +781,15 @@ def _bulk_relaunch(
     rng_next, sub = jax.random.split(state.rng)
     keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(sub, pos)
     num_local = (state.exec_job[None, :] == js[:, None]).sum(-1)
-    tpl = state.job_template[jnp.clip(js, 0, j_cap - 1)]
+    jc = jnp.clip(js, 0, j_cap - 1)
+    sc = jnp.clip(ss, 0, s_cap - 1)
+    tpl = state.job_template[jc]
     durs = jax.vmap(
         lambda key, tp, s_, nl: sample_task_duration(
             params, bank, key, tp, s_, nl,
             jnp.bool_(True), jnp.bool_(True),
         )
-    )(keys, tpl, jnp.clip(ss, 0, s_cap - 1), num_local)
+    )(keys, tpl, sc, num_local)
     new_fin = to + durs
 
     # maximal prefix of relaunches: position i qualifies iff
@@ -785,9 +803,7 @@ def _bulk_relaunch(
     flat = js * s_cap + ss
     earlier = pos[None, :] < pos[:, None]
     cum_before = (earlier & (flat[None, :] == flat[:, None])).sum(-1)
-    rem0 = state.stage_remaining[
-        jnp.clip(js, 0, j_cap - 1), jnp.clip(ss, 0, s_cap - 1)
-    ]
+    rem0 = state.stage_remaining[jc, sc]
     before_star = (to < t_star) | ((to == t_star) & (so < seq_star))
     gen_before = jnp.concatenate(
         [jnp.full((1,), INF), lax.cummin(new_fin)[:-1]]
@@ -810,44 +826,56 @@ def _bulk_relaunch(
 
     # per-executor: new finish event at t_i + dur_i with seq = counter + i
     new_seq = state.seq_counter + pos
-    sel = prefix[:, None] & (order[:, None] == pos[None, :])  # [i, e]
+    sel = prefix[:, None] & perm  # [position, executor]
     upd_e = sel.any(0)
     fin_e = jnp.where(sel, new_fin[:, None], 0.0).sum(0)
     seq_e = jnp.where(sel, new_seq[:, None], 0).sum(0)
 
-    # per-stage: launch counts, last-writer duration, task-exhaustion
-    m = (
-        (js[:, None] == jnp.arange(j_cap)[None, :])[:, :, None]
-        & (ss[:, None] == jnp.arange(s_cap)[None, :])[:, None, :]
-        & prefix[:, None, None]
-    )  # [N, J, S]
+    # per-stage quantities, scattered into [J,S] through as few [N,J,S]
+    # passes as possible — these masked reduces are the bulk pass's main
+    # cost (piecewise probe, 2026-07-30); everything per-stage is first
+    # computed per-CANDIDATE (N-sized, N^2 comparisons and [N] gathers
+    # are near-free), then scattered in one payload reduce each
+    oh_j = js[:, None] == jnp.arange(j_cap)[None, :]  # [N, J]
+    oh_s = ss[:, None] == jnp.arange(s_cap)[None, :]  # [N, S]
+    m = oh_j[:, :, None] & oh_s[:, None, :] & prefix[:, None, None]
     cnt = m.sum(0).astype(_i32)
     aff = cnt > 0
     rem_new = state.stage_remaining - cnt
     exhausted = aff & (cnt == state.stage_remaining)
-    last_pos = jnp.where(m, pos[:, None, None] + 1, 0).max(0)
-    dur_js = durs[jnp.maximum(last_pos - 1, 0)]
+
+    # last prefix candidate per stage carries its duration into
+    # `stage_duration` (the sequential last-writer)
+    later_same = (
+        (flat[None, :] == flat[:, None])
+        & (pos[None, :] > pos[:, None])
+        & prefix[None, :]
+    )
+    is_last = prefix & ~later_same.any(-1)
+    dur_js = (m & is_last[:, None, None]).astype(durs.dtype)
     stage_duration = jnp.where(
-        last_pos > 0, dur_js, state.stage_duration
+        aff, (dur_js * durs[:, None, None]).sum(0), state.stage_duration
     )
 
     # saturation-cache refresh for every touched stage (_refresh_sat
-    # semantics, batched: demand fell monotonically, one net flip max)
+    # semantics, batched: demand fell monotonically, one net flip max).
+    # The children update gathers each touched stage's old/new
+    # saturation and adjacency ROW per candidate and scatters the delta
+    # — never materializing a [J,S,S] product (tiny integer matmuls /
+    # full-adjacency reduces both measured ~ms-scale per micro-step)
     demand = rem_new - state.moving_count - state.commit_count
     sat_new = demand <= 0
-    delta = jnp.where(
-        aff & state.stage_exists,
-        sat_new.astype(_i32) - state.stage_sat.astype(_i32),
+    delta_i = jnp.where(
+        is_last & state.stage_exists[jc, sc],
+        sat_new[jc, sc].astype(_i32)
+        - state.stage_sat[jc, sc].astype(_i32),
         0,
-    )
-    # children update as broadcast-multiply-reduce, NOT einsum: a
-    # "js,jsc->jc" contraction lowers to J tiny [1,S]x[S,S] integer
-    # matmuls per lane — padded to MXU tiles they cost ~mllisecond-scale
-    # per micro-step on TPU, while this elementwise form is a single
-    # fused reduce
+    )  # [N]
+    adj_row = state.adj[jc, sc]  # [N, S] children of each touched stage
     unsat = state.unsat_parent_count - (
-        delta[:, :, None] * state.adj.astype(_i32)
-    ).sum(axis=1)
+        oh_j[:, :, None]
+        * (delta_i[:, None] * adj_row.astype(_i32))[:, None, :]
+    ).sum(0)
 
     wall = jnp.where(
         k > 0, jnp.where(prefix, to, -INF).max(), state.wall_time
