@@ -753,11 +753,18 @@ def _bulk_relaunch(
 
     # executors sorted by (finish_time, finish_seq) = processing order.
     # The permutation is computed as an N x N pairwise-comparison rank
-    # matrix rather than a lexsort + gathers: seqs are unique so ranks
-    # are a permutation, and the one-hot matrix P (P[r, i] = executor i
-    # sits at sorted position r) turns every "sort + gather" and the
-    # later position->executor scatter into masked reduces — no sort or
-    # gather primitives in the hot path.
+    # matrix rather than a lexsort + gathers; the matrix (perm[r, i] =
+    # executor i sits at sorted position r) turns every "sort + gather"
+    # and the later position->executor scatter into masked reduces.
+    # CAVEAT: ranks are a true permutation only among executors with
+    # PENDING finish events, whose (time, seq) keys are unique. Idle
+    # executors all sit at (INF, stale seq): their ranks can collide,
+    # making some perm rows empty/multi-hot and the by_pos values at
+    # those positions garbage. That is sound here ONLY because every
+    # consumer masks by the prefix, which `isfinite(to)` cuts before
+    # the first such position — do not reuse to/so/js/ss (or products
+    # like num_local/durs) outside a prefix-masked expression, and do
+    # not copy this pattern anywhere finite keys can tie.
     tf = state.exec_finish_time
     sf = state.exec_finish_seq
     gt = (tf[:, None] > tf[None, :]) | (

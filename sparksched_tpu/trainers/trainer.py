@@ -84,6 +84,15 @@ class Trainer(abc.ABC):
 
     def __init__(self, agent_cfg: CfgType, env_cfg: CfgType,
                  train_cfg: CfgType, mesh=None) -> None:
+        # TPU-friendly rbg PRNG for the whole training program (the env
+        # hot loop draws several keys per micro-step; see
+        # config.use_fast_prng). Must run before any key is created.
+        # An rng checkpointed under one impl resumes only under the
+        # same impl (uint32[4] vs uint32[2] keys).
+        if train_cfg.get("fast_prng", False):
+            from ..config import use_fast_prng
+
+            use_fast_prng()
         self.seed: int = train_cfg.get("seed", 42)
         self.num_iterations: int = train_cfg["num_iterations"]
         self.num_sequences: int = train_cfg["num_sequences"]
