@@ -45,6 +45,18 @@ def stage_sweep():
     scripts_burst_sweep.main()
 
 
+def stage_bulk_probe():
+    import scripts_bulk_probe
+
+    scripts_bulk_probe.main()
+
+
+def stage_bulk_pieces():
+    import scripts_bulk_pieces
+
+    scripts_bulk_pieces.main([0, 3, 5, 6, 7])
+
+
 def stage_bench():
     import bench
 
@@ -91,6 +103,8 @@ STAGES = {
     "3": ("headline bench", stage_bench),
     "4": ("decima benches", stage_bench_decima),
     "5": ("flagship check", stage_flagship),
+    "6": ("bulk probe", stage_bulk_probe),
+    "7": ("bulk pieces", stage_bulk_pieces),
 }
 
 

@@ -12,7 +12,7 @@ jax.block_until_ready((jnp.ones((256,256)) @ jnp.ones((256,256))).sum())
 print('ALIVE')
 " 2>/dev/null | grep -q ALIVE; then
     echo "chip alive at $(date +%H:%M:%S); running session"
-    timeout 3500 python scripts_chip_session.py 1 2 3 4 5
+    timeout 4500 python scripts_chip_session.py 1 6 3 4 5
     echo "session rc=$? at $(date +%H:%M:%S)"
     exit 0
   fi
