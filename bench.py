@@ -60,6 +60,8 @@ SUB_BATCH = int(os.environ.get("BENCH_SUB_BATCH", 512))
 # the tunnel also kills device programs that run for tens of seconds, so
 # keep each timed program short and accumulate across calls
 BURST = int(os.environ.get("BENCH_BURST", 1))  # event sub-steps per group
+# cascade length of the bulk-relaunch scan (core._bulk_relaunch)
+BULK_EVENTS = int(os.environ.get("BENCH_BULK_EVENTS", 8))
 MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
 assert NUM_ENVS % SUB_BATCH == 0, (
     f"BENCH_SUB_BATCH={SUB_BATCH} must divide {NUM_ENVS}"
@@ -84,7 +86,8 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs):
         return run_flat(
             params, bank, pol, rng, MICRO_CHUNK // BURST,
             auto_reset=False, compute_levels=False, event_burst=BURST,
-            loop_state=ls,
+            event_bulk=BULK_EVENTS > 0,
+            bulk_events=max(BULK_EVENTS, 1), loop_state=ls,
         )
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]

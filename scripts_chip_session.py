@@ -51,12 +51,6 @@ def stage_bulk_probe():
     scripts_bulk_probe.main()
 
 
-def stage_bulk_pieces():
-    import scripts_bulk_pieces
-
-    scripts_bulk_pieces.main([0, 3, 5, 6, 7])
-
-
 def stage_bench():
     import bench
 
@@ -104,7 +98,6 @@ STAGES = {
     "4": ("decima benches", stage_bench_decima),
     "5": ("flagship check", stage_flagship),
     "6": ("bulk probe", stage_bulk_probe),
-    "7": ("bulk pieces", stage_bulk_pieces),
 }
 
 

@@ -472,15 +472,13 @@ def _fulfill_from_source(
     idle = state.source_pool_mask() & ~state.exec_executing
     num_idle = jnp.where(active, idle.sum(), 0)
 
-    exec_order = jnp.argsort(jnp.where(idle, jnp.arange(n), BIG_SEQ))
+    exec_order = _rank_order(jnp.where(idle, jnp.arange(n), BIG_SEQ))
     match = (
         state.cm_valid
         & (state.cm_src_job == state.source_job)
         & (state.cm_src_stage == state.source_stage)
     )
-    slot_order = jnp.argsort(
-        jnp.where(match, state.cm_seq, BIG_SEQ), stable=True
-    )
+    slot_order = _rank_order(jnp.where(match, state.cm_seq, BIG_SEQ))
 
     def body(k, st: EnvState) -> EnvState:
         e = exec_order[k]
@@ -697,41 +695,71 @@ def _next_event(params: EnvParams, state: EnvState):
     return has, tmin, kind, arg
 
 
+def _rank_order(key: jnp.ndarray) -> jnp.ndarray:
+    """Stable ascending order of `key` as an index array — the
+    `jnp.argsort(..., stable=True)` contract (ties break by index) —
+    via an N x N pairwise rank matrix instead of a sort primitive: for
+    the engine's N-sized keys a batched sort kernel costs far more than
+    these few elementwise reduces."""
+    n = key.shape[0]
+    pos = jnp.arange(n)
+    lt = (key[None, :] < key[:, None]) | (
+        (key[None, :] == key[:, None]) & (pos[None, :] < pos[:, None])
+    )
+    rank = lt.sum(-1)
+    perm = rank[None, :] == pos[:, None]
+    return jnp.where(perm, pos[None, :], 0).sum(-1).astype(_i32)
+
+
 def _bulk_relaunch(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
     enabled: jnp.ndarray, stop_at_limit: bool = False,
+    max_events: int = 8,
 ):
-    """Pop the maximal run of consecutive *task relaunch* events in one
-    vectorized pass. Returns (state, k) with k the number of events
-    consumed (0 when the next event is not a relaunch, the queue is
-    drained, or `enabled` is False — callers fall back to the
-    single-event path).
+    """Pop up to `max_events` consecutive *task relaunch* events in one
+    pass. Returns (state, k) with k the number of events consumed (0
+    when the next event is not a relaunch, the queue is drained, or
+    `enabled` is False — callers fall back to the single-event path).
 
     A relaunch is a TASK_FINISHED event on a stage that still has
     unlaunched tasks at processing time (`stage_remaining > 0`): the
     executor immediately launches the stage's next task
     (`_handle_task_finished`'s more_tasks path resolving to A_START).
     These are by far the most common events (one per task, 100s per
-    stage), and a run of them is order-equivalent to popping one by one:
+    stage). Two facts make a whole run of them processable in one
+    micro-step:
 
-    - the source pool is always empty when events are being popped
+    - the source pool is always empty while events are being popped
       (`clear_round`/`move_and_clear` precede every pop), so
       `num_committable() == 0` and `round_ready` cannot flip mid-run
       even when a relaunch saturates a parent stage and readies its
-      children;
-    - relaunches touch no pools, commitments, sources or frontiers —
-      only per-executor finish times/seqs and per-stage counters, whose
-      sequential updates commute into per-stage sums;
-    - each event's duration draw uses its own rng key, so the batched
-      draw matches the sequential distribution (streams differ — the
-      engine makes no bit-exactness promise for stochastic banks).
+      children; relaunches touch no pools, commitments, sources or
+      frontiers;
+    - an executor only ever relaunches on its OWN stage, so the whole
+      cascade's evolving state is N-sized: per-executor pending
+      (time, seq), a shared per-stage remaining-task view, launch
+      counts, and the per-stage last duration.
 
-    The run stops before the first event that is not a relaunch in its
-    processing order: a non-finish event with an earlier (time, seq), or
-    a finish on a stage whose unlaunched tasks the run has exhausted.
-    With `stop_at_limit` (the flat engine's per-micro-step episode-end
-    check) the run also stops just after the first event at or past the
-    episode time limit, which is where that engine freezes/resets.
+    The cascade is replayed in EXACT sequential order by a bounded
+    `lax.scan`: each step picks the lexicographic (time, seq) minimum
+    pending finish — the same tie-break as `_next_event` — checks the
+    handler's relaunch condition against the live remaining view, and
+    relaunches with a pre-sampled duration and the exact sequential
+    seq-counter value. Newly generated events participate in later
+    steps, so ordering (including ties against competitors and among
+    generated events) is bit-identical to the one-event path; only the
+    rng STREAM differs (each potential event has its own pre-derived
+    key), which the engine does not promise for stochastic banks.
+
+    The scan stops at the first event that is not a relaunch — a
+    non-finish event with an earlier (time, seq), or a finish on a
+    stage whose unlaunched tasks the run exhausted — leaving it
+    pending for the single-event path. With `stop_at_limit` (the flat
+    engine's per-micro-step episode-end check) it also stops right
+    after the first event at or past the episode time limit, where
+    that engine freezes/resets. A run longer than `max_events`
+    resumes on the next micro-step: the cascade state is always
+    consistent.
     """
     n = state.exec_finish_time.shape[0]
     j_cap, s_cap = state.stage_remaining.shape
@@ -751,164 +779,142 @@ def _bulk_relaunch(
         jnp.where(at == t_star, aseq, BIG_SEQ),
     )
 
-    # executors sorted by (finish_time, finish_seq) = processing order.
-    # The permutation is computed as an N x N pairwise-comparison rank
-    # matrix rather than a lexsort + gathers; the matrix (perm[r, i] =
-    # executor i sits at sorted position r) turns every "sort + gather"
-    # and the later position->executor scatter into masked reduces.
-    # CAVEAT: ranks are a true permutation only among executors with
-    # PENDING finish events, whose (time, seq) keys are unique. Idle
-    # executors all sit at (INF, stale seq): their ranks can collide,
-    # making some perm rows empty/multi-hot and the by_pos values at
-    # those positions garbage. That is sound here ONLY because every
-    # consumer masks by the prefix, which `isfinite(to)` cuts before
-    # the first such position — do not reuse to/so/js/ss (or products
-    # like num_local/durs) outside a prefix-masked expression, and do
-    # not copy this pattern anywhere finite keys can tie.
-    tf = state.exec_finish_time
-    sf = state.exec_finish_seq
-    gt = (tf[:, None] > tf[None, :]) | (
-        (tf[:, None] == tf[None, :]) & (sf[:, None] > sf[None, :])
+    # static per-executor facts for the whole cascade: stage identity,
+    # same-stage sharing, job-local executor count (for the duration
+    # model's executor-level interpolation)
+    je = state.exec_job
+    se = state.exec_task_stage
+    executing = jnp.isfinite(state.exec_finish_time)
+    jc = jnp.clip(je, 0, j_cap - 1)
+    sc = jnp.clip(se, 0, s_cap - 1)
+    same = (
+        (je[:, None] == je[None, :])
+        & (se[:, None] == se[None, :])
+        & executing[:, None]
+        & executing[None, :]
     )
-    rank = gt.sum(-1)  # sorted position of executor i
-    perm = rank[None, :] == pos[:, None]  # [position, executor]
-
-    def by_pos(x):
-        return jnp.where(perm, x[None, :], 0).sum(-1)
-
-    to = jnp.where(perm, tf[None, :], INF).min(-1)
-    so = by_pos(sf)
-    js = by_pos(state.exec_job)
-    ss = by_pos(state.exec_task_stage)
-
-    # durations are sampled for every candidate up front (one independent
-    # key per event — order along the run is immaterial, see docstring;
-    # rng advances once iff the bulk fires) because the prefix condition
-    # below needs the *generated* event times
-    rng_next, sub = jax.random.split(state.rng)
-    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(sub, pos)
-    num_local = (state.exec_job[None, :] == js[:, None]).sum(-1)
-    jc = jnp.clip(js, 0, j_cap - 1)
-    sc = jnp.clip(ss, 0, s_cap - 1)
+    num_local = (je[None, :] == je[:, None]).sum(-1)
     tpl = state.job_template[jc]
-    durs = jax.vmap(
-        lambda key, tp, s_, nl: sample_task_duration(
-            params, bank, key, tp, s_, nl,
+
+    # pre-sampled durations: dur_table[i, e] is the draw consumed if
+    # the i-th processed event belongs to executor e. Each (i, e) key
+    # is independent and the selection of e at step i depends only on
+    # draws from earlier steps, so the consumed draws are i.i.d. from
+    # the correct per-stage distribution; unconsumed draws are
+    # discarded. Deterministic banks (the parity fixtures) are
+    # unaffected. rng advances once iff the bulk fires.
+    rng_next, sub = jax.random.split(state.rng)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        sub, jnp.arange(max_events * n)
+    )
+    e_rep = jnp.tile(pos, max_events)
+    dur_table = jax.vmap(
+        lambda key, e: sample_task_duration(
+            params, bank, key, tpl[e], sc[e], num_local[e],
             jnp.bool_(True), jnp.bool_(True),
         )
-    )(keys, tpl, sc, num_local)
-    new_fin = to + durs
+    )(keys, e_rep).reshape(max_events, n)
 
-    # maximal prefix of relaunches: position i qualifies iff
-    # - its event precedes every pending non-finish event,
-    # - the i earlier launches leave its stage with unlaunched tasks
-    #   (the handler's remaining > 0), and
-    # - no earlier relaunch in the run GENERATED an event that precedes
-    #   it: a relaunch pushes a new finish at t_j + dur_j, which the
-    #   sequential loop would pop before any later-timed candidate (ties
-    #   go to the pending event — generated seqs are larger)
-    flat = js * s_cap + ss
-    earlier = pos[None, :] < pos[:, None]
-    cum_before = (earlier & (flat[None, :] == flat[:, None])).sum(-1)
-    rem0 = state.stage_remaining[jc, sc]
-    before_star = (to < t_star) | ((to == t_star) & (so < seq_star))
-    gen_before = jnp.concatenate(
-        [jnp.full((1,), INF), lax.cummin(new_fin)[:-1]]
+    def step_fn(carry, dur_row):
+        t_e, sq_e, rem_e, k_e, ldur_e, counter, wall, active, crossed \
+            = carry
+        tmin = t_e.min()
+        has = jnp.isfinite(tmin)
+        cand = t_e == tmin
+        smin = jnp.where(cand, sq_e, BIG_SEQ).min()
+        e_oh = cand & (sq_e == smin)  # unique among pending finishes
+        before = (tmin < t_star) | ((tmin == t_star) & (smin < seq_star))
+        rem_i = jnp.where(e_oh, rem_e, 0).sum()
+        ok = active & has & before & (rem_i > 0)
+        if stop_at_limit:
+            ok = ok & ~crossed
+            crossed = crossed | (ok & (tmin >= state.time_limit))
+        srow = (e_oh[:, None] & same).any(0)  # e*'s same-stage row
+        dur_i = jnp.where(e_oh, dur_row, 0.0).sum()
+        t_e = jnp.where(ok & e_oh, tmin + dur_i, t_e)
+        sq_e = jnp.where(ok & e_oh, counter, sq_e)
+        rem_e = rem_e - (ok & srow).astype(_i32)
+        k_e = k_e + (ok & e_oh).astype(_i32)
+        ldur_e = jnp.where(ok & srow, dur_i, ldur_e)
+        counter = counter + ok.astype(_i32)
+        wall = jnp.where(ok, tmin, wall)
+        active = active & ok  # sequential order: first rejection stops
+        return (
+            t_e, sq_e, rem_e, k_e, ldur_e, counter, wall, active,
+            crossed,
+        ), None
+
+    carry0 = (
+        state.exec_finish_time,
+        state.exec_finish_seq,
+        state.stage_remaining[jc, sc],
+        jnp.zeros(n, _i32),
+        jnp.zeros(n, jnp.float32),
+        state.seq_counter,
+        state.wall_time,
+        jnp.asarray(enabled, bool),
+        jnp.bool_(False),
     )
-    ok = (
-        jnp.isfinite(to)
-        & before_star
-        & (cum_before < rem0)
-        & (to <= gen_before)
+    (t_e, sq_e, rem_e, k_e, ldur_e, counter, wall, _, _), _ = lax.scan(
+        step_fn, carry0, dur_table
     )
-    if stop_at_limit:
-        crossed_before = (
-            jnp.concatenate(
-                [jnp.zeros(1, bool), (to >= state.time_limit)[:-1]]
-            ).cumsum() > 0
-        )
-        ok &= ~crossed_before
-    prefix = (jnp.cumsum((~ok).astype(_i32)) == 0) & enabled
-    k = prefix.sum().astype(_i32)
+    k = k_e.sum()
+    bulked = k > 0
+    touched = k_e > 0
 
-    # per-executor: new finish event at t_i + dur_i with seq = counter + i
-    new_seq = state.seq_counter + pos
-    sel = prefix[:, None] & perm  # [position, executor]
-    upd_e = sel.any(0)
-    fin_e = jnp.where(sel, new_fin[:, None], 0.0).sum(0)
-    seq_e = jnp.where(sel, new_seq[:, None], 0).sum(0)
+    # one representative executor per touched stage (same-stage views
+    # are kept consistent by the scan, so any member would do; pick the
+    # minimal index to scatter each stage exactly once)
+    first_touched = jnp.where(same & touched[None, :], pos[None, :], n
+                              ).min(-1)
+    rep = touched & (pos == first_touched)
 
-    # per-stage quantities, scattered into [J,S] through as few [N,J,S]
-    # passes as possible — these masked reduces are the bulk pass's main
-    # cost (piecewise probe, 2026-07-30); everything per-stage is first
-    # computed per-CANDIDATE (N-sized, N^2 comparisons and [N] gathers
-    # are near-free), then scattered in one payload reduce each
-    oh_j = js[:, None] == jnp.arange(j_cap)[None, :]  # [N, J]
-    oh_s = ss[:, None] == jnp.arange(s_cap)[None, :]  # [N, S]
-    m = oh_j[:, :, None] & oh_s[:, None, :] & prefix[:, None, None]
-    cnt = m.sum(0).astype(_i32)
-    aff = cnt > 0
-    rem_new = state.stage_remaining - cnt
-    exhausted = aff & (cnt == state.stage_remaining)
-
-    # last prefix candidate per stage carries its duration into
-    # `stage_duration` (the sequential last-writer)
-    later_same = (
-        (flat[None, :] == flat[:, None])
-        & (pos[None, :] > pos[:, None])
-        & prefix[None, :]
+    # per-representative stage quantities (all [N]-sized + gathers)
+    cnt_i = ((same & touched[None, :]) * k_e[None, :]).sum(-1)
+    exhausted_i = rep & (rem_e == 0)
+    demand_i = (
+        rem_e - state.moving_count[jc, sc] - state.commit_count[jc, sc]
     )
-    is_last = prefix & ~later_same.any(-1)
-    dur_js = (m & is_last[:, None, None]).astype(durs.dtype)
-    stage_duration = jnp.where(
-        aff, (dur_js * durs[:, None, None]).sum(0), state.stage_duration
-    )
-
-    # saturation-cache refresh for every touched stage (_refresh_sat
-    # semantics, batched: demand fell monotonically, one net flip max).
-    # The children update gathers each touched stage's old/new
-    # saturation and adjacency ROW per candidate and scatters the delta
-    # — never materializing a [J,S,S] product (tiny integer matmuls /
-    # full-adjacency reduces both measured ~ms-scale per micro-step)
-    demand = rem_new - state.moving_count - state.commit_count
-    sat_new = demand <= 0
+    sat_new_i = demand_i <= 0
     delta_i = jnp.where(
-        is_last & state.stage_exists[jc, sc],
-        sat_new[jc, sc].astype(_i32)
-        - state.stage_sat[jc, sc].astype(_i32),
+        rep & state.stage_exists[jc, sc],
+        sat_new_i.astype(_i32) - state.stage_sat[jc, sc].astype(_i32),
         0,
-    )  # [N]
-    adj_row = state.adj[jc, sc]  # [N, S] children of each touched stage
+    )
+    adj_row = state.adj[jc, sc]  # [N, S] children of each rep's stage
+
+    # scatter into [J,S] through rep-masked payload reduces
+    oh_j = je[:, None] == jnp.arange(j_cap)[None, :]
+    oh_s = se[:, None] == jnp.arange(s_cap)[None, :]
+    m = oh_j[:, :, None] & oh_s[:, None, :] & rep[:, None, None]
+    cnt = (m * cnt_i[:, None, None]).sum(0)
+    aff = cnt > 0
+    dur_js = (m * ldur_e[:, None, None]).sum(0)
+    sat_js = (m & sat_new_i[:, None, None]).any(0)
     unsat = state.unsat_parent_count - (
         oh_j[:, :, None]
         * (delta_i[:, None] * adj_row.astype(_i32))[:, None, :]
     ).sum(0)
 
-    wall = jnp.where(
-        k > 0, jnp.where(prefix, to, -INF).max(), state.wall_time
-    )
-    bulked = k > 0
     return state.replace(
         rng=jnp.where(bulked, rng_next, state.rng),
         wall_time=wall,
-        seq_counter=state.seq_counter + k,
-        exec_finish_time=jnp.where(
-            upd_e, fin_e, state.exec_finish_time
-        ),
-        exec_finish_seq=jnp.where(upd_e, seq_e, state.exec_finish_seq),
-        stage_remaining=rem_new,
+        seq_counter=counter,
+        exec_finish_time=jnp.where(touched, t_e, state.exec_finish_time),
+        exec_finish_seq=jnp.where(touched, sq_e, state.exec_finish_seq),
+        stage_remaining=state.stage_remaining - cnt,
         stage_completed_tasks=state.stage_completed_tasks + cnt,
-        stage_duration=stage_duration,
+        stage_duration=jnp.where(aff, dur_js, state.stage_duration),
         job_saturated_stages=state.job_saturated_stages
-        + exhausted.sum(-1).astype(_i32),
-        stage_sat=jnp.where(aff, sat_new, state.stage_sat),
+        + (oh_j & exhausted_i[:, None]).sum(0).astype(_i32),
+        stage_sat=jnp.where(aff, sat_js, state.stage_sat),
         unsat_parent_count=unsat,
     ), k
 
 
 def _resume_simulation(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
-    active: jnp.ndarray, bulk: bool = True
+    active: jnp.ndarray, bulk: bool = True, bulk_events: int = 8
 ) -> EnvState:
     """Pop events until there are new scheduling decisions to make or the
     queue drains (reference :320-343). `active` masks the whole loop.
@@ -922,7 +928,10 @@ def _resume_simulation(
 
     def body(st: EnvState) -> EnvState:
         if bulk:
-            st, nb = _bulk_relaunch(params, bank, st, jnp.bool_(True))
+            st, nb = _bulk_relaunch(
+                params, bank, st, jnp.bool_(True),
+                max_events=bulk_events,
+            )
             single = nb == 0
         else:
             single = jnp.bool_(True)
@@ -1097,10 +1106,13 @@ def reset_from_sequence(
     return state.replace(schedulable=sched, round_ready=jnp.bool_(True))
 
 
-@partial(jax.jit, static_argnums=0, static_argnames=("bulk",))
+@partial(
+    jax.jit, static_argnums=0, static_argnames=("bulk", "bulk_events")
+)
 def step(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
-    stage_idx: jnp.ndarray, num_exec: jnp.ndarray, *, bulk: bool = True
+    stage_idx: jnp.ndarray, num_exec: jnp.ndarray, *, bulk: bool = True,
+    bulk_events: int = 8
 ):
     """One decision step (reference :188-221). Returns
     (state, reward, terminated, truncated). `bulk=False` forces the
@@ -1155,7 +1167,9 @@ def step(
     state = lax.cond(active, clear_round, lambda st: st, state)
     t_old = state.wall_time
     active_old = state.job_active
-    state = _resume_simulation(params, bank, state, active, bulk=bulk)
+    state = _resume_simulation(
+        params, bank, state, active, bulk=bulk, bulk_events=bulk_events
+    )
     reward = jnp.where(
         active, -_compute_jobtime(params, state, t_old, active_old), 0.0
     )

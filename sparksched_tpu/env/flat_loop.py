@@ -33,6 +33,7 @@ from ..config import EnvParams
 from ..workload.bank import WorkloadBank
 from .core import (
     RQ_NONE,
+    _rank_order,
     _onehot2,
     _add_commitment,
     _apply_action,
@@ -131,6 +132,7 @@ def micro_step(
     auto_reset: bool = True,
     compute_levels: bool = True,
     event_bulk: bool = True,
+    bulk_events: int = 8,
 ) -> LoopState:
     """One unit of work for one lane (vmap over lanes). With
     `event_bulk`, an EVENT micro-step consumes a whole run of relaunch
@@ -142,7 +144,8 @@ def micro_step(
     ls0 = ls  # pre-bulk state: the freeze path must restore exactly this
     if event_bulk:
         env_b, nb = _bulk_relaunch(
-            params, bank, ls.env, ls.mode == M_EVENT, stop_at_limit=True
+            params, bank, ls.env, ls.mode == M_EVENT,
+            stop_at_limit=True, max_events=bulk_events,
         )
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
     else:
@@ -186,17 +189,17 @@ def micro_step(
             st = _commit_remaining(st)
             idle = st.source_pool_mask() & ~st.exec_executing
             num_idle = idle.sum().astype(_i32)
-            exec_order = jnp.argsort(
+            exec_order = _rank_order(
                 jnp.where(idle, jnp.arange(n), BIG_SEQ)
-            ).astype(_i32)
+            )
             match = (
                 st.cm_valid
                 & (st.cm_src_job == st.source_job)
                 & (st.cm_src_stage == st.source_stage)
             )
-            slot_order = jnp.argsort(
-                jnp.where(match, st.cm_seq, BIG_SEQ), stable=True
-            ).astype(_i32)
+            slot_order = _rank_order(
+                jnp.where(match, st.cm_seq, BIG_SEQ)
+            )
             # empty fulfillment: clear and go straight to events
             st = lax.cond(
                 num_idle == 0, _clear_round, lambda x: x, st
@@ -361,6 +364,7 @@ def event_micro_step(
     rng: jax.Array,
     auto_reset: bool = True,
     event_bulk: bool = True,
+    bulk_events: int = 8,
 ) -> LoopState:
     """One EVENT-only micro-step: lanes in M_EVENT mode pop + handle one
     event (with the full shared tail); other lanes no-op.
@@ -379,7 +383,8 @@ def event_micro_step(
     ls0 = ls.replace(mode=_i32(M_EVENT))  # pre-bulk state for the tail
     if event_bulk:
         env_b, nb = _bulk_relaunch(
-            params, bank, ls.env, is_event, stop_at_limit=True
+            params, bank, ls.env, is_event,
+            stop_at_limit=True, max_events=bulk_events,
         )
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
         pop_on = is_event & (nb == 0)
@@ -408,6 +413,7 @@ def run_flat(
     compute_levels: bool = True,
     event_burst: int = 1,
     event_bulk: bool = True,
+    bulk_events: int = 8,
     loop_state: LoopState | None = None,
 ) -> LoopState:
     """Scan `num_groups` micro-step groups for one lane (vmap over
@@ -423,12 +429,13 @@ def run_flat(
         k, sub = jax.random.split(k)
         ls = micro_step(
             params, bank, policy_fn, ls, sub, auto_reset,
-            compute_levels, event_bulk,
+            compute_levels, event_bulk, bulk_events,
         )
         for _ in range(event_burst - 1):
             k, sub = jax.random.split(k)
             ls = event_micro_step(
-                params, bank, ls, sub, auto_reset, event_bulk
+                params, bank, ls, sub, auto_reset, event_bulk,
+                bulk_events,
             )
         return (ls, k), None
 
