@@ -152,3 +152,29 @@ def test_bank_shapes(small_setup):
     for t in [0, 50, 153]:
         for s in range(ns[t]):
             assert cnt[t, s].sum() > 0
+
+
+def test_rank_order_matches_stable_argsort():
+    """_rank_order (the hot path's sort-free ordering primitive) must
+    reproduce jnp.argsort(stable=True) exactly, including ties (slots
+    from one add_commitment share a seq; idle executors share BIG_SEQ
+    keys)."""
+    import jax.numpy as jnp
+
+    from sparksched_tpu.env.core import _rank_order
+
+    rng = np.random.default_rng(0)
+    for n in (1, 4, 10, 16):
+        for _ in range(20):
+            key = jnp.asarray(
+                rng.integers(0, max(2, n // 2), size=n), jnp.int32
+            )
+            got = np.asarray(_rank_order(key))
+            want = np.asarray(jnp.argsort(key, stable=True))
+            np.testing.assert_array_equal(got, want)
+    # float keys with INF padding (finish-time shaped)
+    key = jnp.asarray([3.0, np.inf, 1.0, np.inf, 1.0], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(_rank_order(key)),
+        np.asarray(jnp.argsort(key, stable=True)),
+    )
