@@ -6,7 +6,9 @@ average job completion time — the reference's headline claim is that
 Decima beats the fair scheduler on avg JCT (/root/reference/README.md:5-7,
 examples.py:49-81). Writes EVAL.md.
 
-Usage: python scripts_eval_decima.py [num_seeds] [ckpt]
+Usage: python scripts_eval_decima.py [num_seeds] [ckpt|-] [out_md]
+(ckpt "-" keeps the default multi-checkpoint comparison, e.g. to write
+it to a non-default out_md.)
 """
 
 from __future__ import annotations
@@ -75,8 +77,29 @@ def make_decima(params, ckpt):
 
 CKPTS = {
     "decima (tpu-trained)": "models/decima/model_tpu.msgpack",
+    "decima (tpu fine-tuned)": "models/decima/model_ft.msgpack",
     "decima (reference ckpt, converted)": (
         "/root/reference/models/decima/model.pt"
+    ),
+}
+
+# one provenance line per known checkpoint; the report only describes
+# checkpoints it actually evaluated
+PROVENANCE = {
+    "decima (tpu-trained)": (
+        "from-scratch PPO in this framework "
+        "(scripts_train_session.py)"
+    ),
+    "decima (tpu fine-tuned)": (
+        "PPO fine-tune in this framework warm-started from the "
+        "converted reference weights (scripts_finetune_loop.py — the "
+        "reference's own state_dict_path workflow, "
+        "decima/scheduler.py:57-59; train state under "
+        "artifacts/decima_ft)"
+    ),
+    "decima (reference ckpt, converted)": (
+        "the reference's published models/decima/model.pt through the "
+        "torch->flax converter, no training in this framework"
     ),
 }
 
@@ -84,8 +107,9 @@ CKPTS = {
 def main():
     num_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 32
     ckpts = dict(CKPTS)
-    if len(sys.argv) > 2:
+    if len(sys.argv) > 2 and sys.argv[2] != "-":
         ckpts = {"decima": sys.argv[2]}
+    out_md = sys.argv[3] if len(sys.argv) > 3 else "EVAL.md"
     params = EnvParams(**ENV)
     bank = make_workload_bank(params.num_executors, params.max_stages)
     if bank.max_stages != params.max_stages:
@@ -132,6 +156,14 @@ def main():
         "TPC-H jobs (synthetic bank), held-out seeds "
         f"{HELD_OUT_BASE}..{HELD_OUT_BASE + num_seeds - 1}.",
         "",
+        "Checkpoints: "
+        + "; ".join(
+            f"`{n}` = "
+            + PROVENANCE.get(n, f"custom checkpoint {ckpts[n]}")
+            for n in results
+        )
+        + ".",
+        "",
         header,
         "|" + "---|" * (2 + len(results)),
     ]
@@ -152,7 +184,7 @@ def main():
     lines.append("")
     out = "\n".join(lines)
     print(out)
-    with open("EVAL.md", "w") as fp:
+    with open(out_md, "w") as fp:
         fp.write(out)
 
 
