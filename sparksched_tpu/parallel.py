@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DP_AXIS = "dp"
+HOST_AXIS = "host"
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -36,9 +37,49 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (DP_AXIS,))
 
 
+def make_host_device_mesh(
+    n_hosts: int | None = None, devices_per_host: int | None = None,
+    devices=None,
+) -> Mesh:
+    """2-D ("host", "dp") mesh for multi-host runs.
+
+    Lanes shard over BOTH axes (`lane_sharding` spans every mesh axis),
+    so rollout collection stays embarrassingly parallel; the update's
+    reductions become hierarchical collectives — XLA reduces along the
+    fast "dp" (intra-host ICI) axis before the "host" (DCN) axis, which
+    is exactly the hierarchy the reference's per-process workers + one
+    learner lacked. Defaults follow jax's process topology
+    (`jax.process_count()` x local device count); pass explicit factors
+    to build a virtual multi-host mesh on a flat device list (tests)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_hosts is None:
+        n_hosts = jax.process_count()
+    if devices_per_host is None:
+        devices_per_host = len(devices) // n_hosts
+    need = n_hosts * devices_per_host
+    assert len(devices) >= need, (
+        f"need {need} devices, have {len(devices)}"
+    )
+    # jax.devices() order does not guarantee per-host contiguity on all
+    # topologies; group by owning process first so each mesh row really
+    # is one host's chips (otherwise "dp" reductions silently cross DCN
+    # and the hierarchy claim above inverts)
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    grid = np.array(devices[:need]).reshape(n_hosts, devices_per_host)
+    if jax.process_count() > 1:
+        for row in grid:
+            assert len({d.process_index for d in row}) == 1, (
+                "a host row mixes devices from different processes — "
+                "pass explicit per-host `devices`"
+            )
+    return Mesh(grid, (HOST_AXIS, DP_AXIS))
+
+
 def lane_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (env-lane) axis over the dp mesh axis."""
-    return NamedSharding(mesh, P(DP_AXIS))
+    """Shard the leading (env-lane) axis over every mesh axis (1-D dp
+    meshes and 2-D host x device meshes alike)."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
