@@ -460,14 +460,259 @@ def _fulfill_commitment_phase_a(
     return lax.cond(dj < 0, to_common, to_stage, state)
 
 
+def _bulk_fulfill(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    num_idle: jnp.ndarray, exec_order: jnp.ndarray,
+    slot_order: jnp.ndarray,
+):
+    """Consume the maximal *simple* prefix of the fulfillment phase in
+    one vectorized pass. Returns (state, m): candidates 0..m-1 of the
+    (exec_order, slot_order) pairing are fully processed; the caller
+    finishes the rest (backup-scheduling cases) on the one-at-a-time
+    path.
+
+    Each executor is fulfilled at most once per phase, so unlike the
+    relaunch cascade there is no sequential generation structure: the
+    only cross-candidate coupling is through per-stage/per-job counters
+    (unlaunched-task counts, saturated-stage counts, executor-on-job
+    counts), all reconstructible per candidate with N^2 prefix sums.
+    A candidate is *simple* — its classification is static — iff its
+    commitment targets the common pool (dj < 0) or its destination
+    stage still has unlaunched tasks at its turn (rem0 minus earlier
+    prefix starts > 0, the `_resolve_action` unsaturated case, which
+    resolves to A_SEND / A_START / A_PARK by static facts: executor's
+    job vs destination, destination frontier membership). The prefix
+    stops at the first saturated-destination candidate, whose
+    backup-stage search depends on the live saturation caches.
+
+    Matches the sequential path bit-exactly except the rng stream
+    (per-candidate pre-derived keys, as in `_bulk_relaunch`).
+    """
+    n = state.exec_job.shape[0]
+    j_cap, s_cap = state.stage_remaining.shape
+    pos = jnp.arange(n)
+
+    e = exec_order
+    slot = slot_order
+    dj = state.cm_dst_job[slot]
+    ds0 = state.cm_dst_stage[slot]
+    sjs = state.cm_src_job[slot]
+    ejob = state.exec_job[e]
+    djc = jnp.clip(dj, 0, j_cap - 1)
+    dsc = jnp.clip(ds0, 0, s_cap - 1)
+
+    valid = pos < num_idle
+    common_dst = dj < 0
+    send0 = ~common_dst & (ejob != dj)
+    frontier_k = state.frontier[djc, dsc]
+    start0 = ~common_dst & ~send0 & frontier_k
+    park0 = ~common_dst & ~send0 & ~frontier_k
+
+    flat = djc * s_cap + dsc
+    stage_pair = (
+        (flat[None, :] == flat[:, None])
+        & ~common_dst[None, :]
+        & ~common_dst[:, None]
+    )
+    earlier = pos[None, :] < pos[:, None]
+    cum_starts = (earlier & stage_pair & start0[None, :]).sum(-1)
+    rem0 = state.stage_remaining[djc, dsc]
+    saturated = ~common_dst & (rem0 - cum_starts == 0)
+    ok = valid & ~saturated
+    prefix = (jnp.cumsum((~ok).astype(_i32)) == 0) & valid
+    m = prefix.sum().astype(_i32)
+
+    send = send0 & prefix
+    start = start0 & prefix
+    park = park0 & prefix
+    common_k = common_dst & prefix
+
+    # source-pool saturation at each candidate's turn: starts that
+    # launch a destination stage's last task bump the destination job's
+    # saturated-stage count, which a later dj<0 candidate's
+    # _move_idle_from_pool reads for the SOURCE job
+    src_j = state.source_job
+    src_s = state.source_stage
+    newly_exh = start & (rem0 - cum_starts == 1)
+    exh_src_before = (
+        earlier & (newly_exh & (dj == src_j))[None, :]
+    ).sum(-1)
+    src_jc = jnp.maximum(src_j, 0)
+    src_sat_k = (
+        state.job_saturated_stages[src_jc] + exh_src_before
+    ) >= state.job_num_stages[src_jc]
+    noop_move = (src_j < 0) | ((src_s < 0) & ~src_sat_k)
+    to_common = common_k & ~noop_move & src_sat_k
+    moved_any = common_k & ~noop_move  # to common OR up to the job pool
+
+    # executor-on-destination-job count at each candidate's turn (the
+    # duration model's executor-level input): earlier sends/common
+    # moves detach executors from the source job
+    leaver = (send | to_common) & (ejob >= 0)
+    leavers_before = (earlier & leaver[None, :]).sum(-1)
+    base_nl = (state.exec_job[None, :] == dj[:, None]).sum(-1)
+    nl = base_nl - jnp.where(dj == src_j, leavers_before, 0)
+
+    rng_next, sub = jax.random.split(state.rng)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(sub, pos)
+    tpl = state.job_template[djc]
+    tv = state.exec_task_valid[e]
+    ss_same = state.exec_task_stage[e] == ds0
+    durs = jax.vmap(
+        lambda key, tp, s_, nl_, tv_, sm_: sample_task_duration(
+            params, bank, key, tp, s_, nl_, tv_, sm_,
+        )
+    )(keys, tpl, dsc, nl, tv, ss_same)
+
+    inc = (start | send).astype(_i32)
+    seq_k = state.seq_counter + (earlier & (inc[None, :] > 0)).sum(-1)
+    n_inc = inc.sum()
+
+    fin_k = state.wall_time + durs
+    arr_k = jnp.full((n,), state.wall_time + params.moving_delay)
+
+    # ---- per-executor scatters (each candidate's executor is unique)
+    sel = prefix[:, None] & (e[:, None] == pos[None, :])  # [cand, exec]
+
+    def exset(base, cond, payload):
+        msel = sel & cond[:, None]
+        val = jnp.where(msel, payload[:, None], 0).sum(0)
+        return jnp.where(msel.any(0), val.astype(base.dtype), base)
+
+    def exflag(base, cond, value):
+        return jnp.where((sel & cond[:, None]).any(0), value, base)
+
+    minus1 = jnp.full((n,), -1, _i32)
+    exec_stage = exset(
+        state.exec_stage, start | send | park | moved_any,
+        jnp.where(start, ds0, minus1),
+    )
+    exec_task_valid = exflag(
+        exflag(state.exec_task_valid, send | park | to_common, False),
+        start, True,
+    )
+    exec_at_common = exflag(
+        exflag(state.exec_at_common, send, False), to_common, True
+    )
+    exec_job = exset(state.exec_job, send | to_common, minus1)
+    exec_moving = exflag(state.exec_moving, send, True)
+    exec_dst_job = exset(state.exec_dst_job, send, dj)
+    exec_dst_stage = exset(state.exec_dst_stage, send, ds0)
+    exec_arrive_time = exset(state.exec_arrive_time, send, arr_k)
+    exec_arrive_seq = exset(state.exec_arrive_seq, send, seq_k)
+    exec_executing = exflag(state.exec_executing, start, True)
+    exec_task_stage = exset(state.exec_task_stage, start, ds0)
+    exec_finish_time = exset(state.exec_finish_time, start, fin_k)
+    exec_finish_seq = exset(state.exec_finish_seq, start, seq_k)
+
+    # ---- commitment slots (every prefix candidate consumes one)
+    consumed = (
+        prefix[:, None] & (slot[:, None] == pos[None, :])
+    ).any(0)
+    cm_valid = state.cm_valid & ~consumed
+
+    # ---- per-stage counters (destination stages)
+    oh_j = (
+        (dj[:, None] == jnp.arange(j_cap)[None, :])
+        & prefix[:, None]
+        & ~common_dst[:, None]
+    )  # [cand, J]
+    oh_s = ds0[:, None] == jnp.arange(s_cap)[None, :]
+    m3 = oh_j[:, :, None] & oh_s[:, None, :]  # [cand, J, S]
+    cnt_start = (m3 & start[:, None, None]).sum(0).astype(_i32)
+    cnt_send = (m3 & send[:, None, None]).sum(0).astype(_i32)
+    cnt_slot = m3.sum(0).astype(_i32)
+    stage_remaining = state.stage_remaining - cnt_start
+    stage_executing = state.stage_executing + cnt_start
+    moving_count = state.moving_count + cnt_send
+    commit_count = state.commit_count - cnt_slot
+
+    later = pos[None, :] > pos[:, None]
+    is_last_start = start & ~(
+        later & stage_pair & start[None, :]
+    ).any(-1)
+    dur_js = (
+        (m3 & is_last_start[:, None, None]) * durs[:, None, None]
+    ).sum(0)
+    stage_duration = jnp.where(
+        cnt_start > 0, dur_js, state.stage_duration
+    )
+
+    # ---- per-job counters
+    job_supply = (
+        state.job_supply
+        - (oh_j & (dj != sjs)[:, None]).sum(0)  # slot consumption
+        + (oh_j & send[:, None]).sum(0)  # arrivals in transit
+        - _onehot(j_cap, src_jc).astype(_i32)
+        * jnp.where(src_j >= 0, (send & (ejob >= 0)).sum(), 0)
+    )
+    job_saturated_stages = (
+        state.job_saturated_stages
+        + (oh_j & newly_exh[:, None]).sum(0).astype(_i32)
+    )
+
+    # ---- saturation-cache refresh for every touched destination stage
+    aff = cnt_slot > 0
+    demand = stage_remaining - moving_count - commit_count
+    sat_new = demand <= 0
+    is_rep = prefix & ~common_dst & ~(
+        earlier & stage_pair
+    ).any(-1)
+    delta_k = jnp.where(
+        is_rep & state.stage_exists[djc, dsc],
+        sat_new[djc, dsc].astype(_i32)
+        - state.stage_sat[djc, dsc].astype(_i32),
+        0,
+    )
+    adj_row = state.adj[djc, dsc]  # [cand, S]
+    unsat = state.unsat_parent_count - (
+        oh_j[:, :, None]
+        * (delta_k[:, None] * adj_row.astype(_i32))[:, None, :]
+    ).sum(0)
+
+    bulked = m > 0
+    state = state.replace(
+        rng=jnp.where(bulked, rng_next, state.rng),
+        seq_counter=state.seq_counter + n_inc,
+        exec_stage=exec_stage,
+        exec_task_valid=exec_task_valid,
+        exec_at_common=exec_at_common,
+        exec_job=exec_job,
+        exec_moving=exec_moving,
+        exec_dst_job=exec_dst_job,
+        exec_dst_stage=exec_dst_stage,
+        exec_arrive_time=exec_arrive_time,
+        exec_arrive_seq=exec_arrive_seq,
+        exec_executing=exec_executing,
+        exec_task_stage=exec_task_stage,
+        exec_finish_time=exec_finish_time,
+        exec_finish_seq=exec_finish_seq,
+        cm_valid=cm_valid,
+        stage_remaining=stage_remaining,
+        stage_executing=stage_executing,
+        moving_count=moving_count,
+        commit_count=commit_count,
+        stage_duration=stage_duration,
+        job_supply=job_supply,
+        job_saturated_stages=job_saturated_stages,
+        stage_sat=jnp.where(aff, sat_new, state.stage_sat),
+        unsat_parent_count=unsat,
+    )
+    return state, m
+
+
 def _fulfill_from_source(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
-    active: jnp.ndarray
+    active: jnp.ndarray, bulk: bool = True
 ) -> EnvState:
     """reference :730-743 — match the source pool's idle executors against
     its outstanding commitments, in commitment insertion order. `active`
     masks the whole call (used to fold the reference's round-finished
-    branch into straight-line code)."""
+    branch into straight-line code). With `bulk`, the simple prefix of
+    the phase is consumed in one `_bulk_fulfill` pass and only the
+    backup-scheduling tail (usually empty) runs the per-candidate
+    while-loop — under vmap the loop runs the batch-max LEFTOVER count
+    instead of a fixed N iterations."""
     n = state.exec_job.shape[0]
     idle = state.source_pool_mask() & ~state.exec_executing
     num_idle = jnp.where(active, idle.sum(), 0)
@@ -480,23 +725,31 @@ def _fulfill_from_source(
     )
     slot_order = _rank_order(jnp.where(match, state.cm_seq, BIG_SEQ))
 
-    def body(k, st: EnvState) -> EnvState:
+    if bulk:
+        state, k0 = _bulk_fulfill(
+            params, bank, state, num_idle, exec_order, slot_order
+        )
+    else:
+        k0 = _i32(0)
+
+    def cond(carry):
+        k, _ = carry
+        return k < num_idle
+
+    def body(carry):
+        k, st = carry
         e = exec_order[k]
         quirk_src = st.source_job_id()
-
-        def do(st: EnvState):
-            return _fulfill_commitment_phase_a(st, e, slot_order[k])
-
-        def skip(st: EnvState):
-            return st, _i32(RQ_NONE), _i32(-1), _i32(-1)
-
-        st, rk, rj, rs = lax.cond(k < num_idle, do, skip, st)
+        st, rk, rj, rs = _fulfill_commitment_phase_a(
+            st, e, slot_order[k]
+        )
         ak, tj, ts = _resolve_action(
             params, st, rk, e, rj, rs, quirk_src
         )
-        return _apply_action(params, bank, st, ak, e, tj, ts)
+        return k + 1, _apply_action(params, bank, st, ak, e, tj, ts)
 
-    return lax.fori_loop(0, n, body, state)
+    _, state = lax.while_loop(cond, body, (k0, state))
+    return state
 
 
 # --------------------------------------------------------------------------
@@ -1115,9 +1368,12 @@ def step(
     bulk_events: int = 8
 ):
     """One decision step (reference :188-221). Returns
-    (state, reward, terminated, truncated). `bulk=False` forces the
-    event loop onto the one-event-per-iteration path (equivalence
-    testing; see `_bulk_relaunch`)."""
+    (state, reward, terminated, truncated). `bulk=False` forces BOTH
+    vectorized fast paths off — relaunch runs pop one event per
+    iteration (`_bulk_relaunch`) and the fulfillment phase runs one
+    candidate at a time (`_bulk_fulfill`) — for equivalence testing;
+    the rng streams of the two modes differ (per-candidate pre-derived
+    keys vs the sequential chain)."""
     s_cap = params.max_stages
     j = stage_idx // s_cap
     s = stage_idx % s_cap
@@ -1152,7 +1408,7 @@ def step(
         return _commit_remaining(st)
 
     state = lax.cond(active, commit_rest, lambda st: st, state)
-    state = _fulfill_from_source(params, bank, state, active)
+    state = _fulfill_from_source(params, bank, state, active, bulk=bulk)
 
     def clear_round(st: EnvState) -> EnvState:
         return st.replace(
