@@ -60,8 +60,12 @@ SUB_BATCH = int(os.environ.get("BENCH_SUB_BATCH", 512))
 # the tunnel also kills device programs that run for tens of seconds, so
 # keep each timed program short and accumulate across calls
 BURST = int(os.environ.get("BENCH_BURST", 1))  # event sub-steps per group
-# cascade length of the bulk-relaunch scan (core._bulk_relaunch)
-BULK_EVENTS = int(os.environ.get("BENCH_BULK_EVENTS", 8))
+# cascade length of the bulk-relaunch scan (core._bulk_relaunch); unset
+# -> self-calibrate between the cascade (8) and the single-event path
+# (0) with one short chunk each before the timed run, since the
+# op-count-vs-step-count trade differs across backends
+_BULK_ENV = os.environ.get("BENCH_BULK_EVENTS")
+BULK_EVENTS = int(_BULK_ENV) if _BULK_ENV is not None else None
 MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
 assert NUM_ENVS % SUB_BATCH == 0, (
     f"BENCH_SUB_BATCH={SUB_BATCH} must divide {NUM_ENVS}"
@@ -73,8 +77,8 @@ NUM_CHUNKS = 4
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 
 
-@partial(jax.jit, static_argnums=(0,))
-def bench_chunk(params: EnvParams, bank, loop_states, rngs):
+@partial(jax.jit, static_argnums=(0, 4))
+def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events):
     """MICRO_CHUNK flat micro-steps per lane; returns updated loop states
     and the total decision count across the batch."""
 
@@ -86,8 +90,8 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs):
         return run_flat(
             params, bank, pol, rng, MICRO_CHUNK // BURST,
             auto_reset=False, compute_levels=False, event_burst=BURST,
-            event_bulk=BULK_EVENTS > 0,
-            bulk_events=max(BULK_EVENTS, 1), loop_state=ls,
+            event_bulk=bulk_events > 0,
+            bulk_events=max(bulk_events, 1), loop_state=ls,
         )
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
@@ -151,19 +155,47 @@ def main() -> None:
     states = jax.vmap(lambda k: core.reset(params, bank, k))(reset_keys)
     loop_states = jax.vmap(init_loop_state)(states)
 
-    # warmup/compile
+    # warmup/compile (also warms both calibration candidates)
+    cands = [BULK_EVENTS] if BULK_EVENTS is not None else [8, 0]
     keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
-    loop_states, n = bench_chunk(params, bank, loop_states, keys)
+    for be in cands:
+        loop_states, n = bench_chunk(params, bank, loop_states, keys, be)
+        jax.block_until_ready(n)
+        keys = jax.random.split(jax.random.PRNGKey(90 + be), NUM_ENVS)
+    if len(cands) > 1:
+        rates = {}
+        for be in cands:
+            # re-seed finished lanes before each candidate so both
+            # measure the same live-lane precondition
+            loop_states = reset_done_lanes(
+                params, bank, loop_states,
+                jax.random.split(jax.random.PRNGKey(80 + be), NUM_ENVS),
+            )
+            d0 = int(jax.block_until_ready(loop_states.decisions.sum()))
+            kk = jax.random.split(jax.random.PRNGKey(70 + be), NUM_ENVS)
+            tc = time.perf_counter()
+            loop_states, n = bench_chunk(
+                params, bank, loop_states, kk, be
+            )
+            d1 = int(jax.block_until_ready(n))
+            rates[be] = (d1 - d0) / (time.perf_counter() - tc)
+        bulk_events = max(rates, key=rates.get)
+    else:
+        bulk_events = cands[0]
+    # timed run starts from a freshly re-seeded lane population on both
+    # the calibrated and the env-pinned paths
     loop_states = reset_done_lanes(
         params, bank, loop_states,
         jax.random.split(jax.random.PRNGKey(101), NUM_ENVS),
     )
-    base = int(jax.block_until_ready(n))
+    base = int(jax.block_until_ready(loop_states.decisions.sum()))
 
     t0 = time.perf_counter()
     for i in range(NUM_CHUNKS):
         keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
-        loop_states, n = bench_chunk(params, bank, loop_states, keys)
+        loop_states, n = bench_chunk(
+            params, bank, loop_states, keys, bulk_events
+        )
         loop_states = reset_done_lanes(
             params, bank, loop_states,
             jax.random.split(jax.random.PRNGKey(102 + i), NUM_ENVS),
