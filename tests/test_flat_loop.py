@@ -273,3 +273,28 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(monkeypatch):
             if bool(term):
                 break
         assert bool(term), f"seed {seed}: episode did not finish"
+
+        # the flat micro-step engine (bench path, single-fulfill steps)
+        # must land on the same terminal state as the per-decision loop
+        from sparksched_tpu.env.flat_loop import run_flat
+
+        def pol(rng, obs):
+            si, ne = round_robin_policy(obs, params.num_executors, True)
+            return si, ne, {}
+
+        ls = jax.jit(
+            lambda s, r: run_flat(
+                params, bank, pol, r, 6000, s, auto_reset=False,
+            )
+        )(core.reset(params, bank, jax.random.PRNGKey(seed)),
+          jax.random.PRNGKey(0))
+        assert int(ls.episodes) == 1, f"seed {seed}: flat episode open"
+        np.testing.assert_allclose(
+            float(ls.env.wall_time), float(sa.wall_time), rtol=1e-6,
+            err_msg=f"seed {seed}: flat wall_time",
+        )
+        np.testing.assert_allclose(
+            np.asarray(ls.env.job_t_completed),
+            np.asarray(sa.job_t_completed), rtol=1e-6,
+            err_msg=f"seed {seed}: flat job completion times",
+        )
