@@ -14,6 +14,10 @@ print('ALIVE')
     echo "chip alive at $(date +%H:%M:%S); running session"
     timeout 4500 python scripts_chip_session.py 1 6 3 4 5
     echo "session rc=$? at $(date +%H:%M:%S)"
+    # use remaining chip time for on-chip PPO training sessions
+    # (resumable; scripts_train_loop honors the chip platform default)
+    timeout 5400 python scripts_train_loop.py 20 3
+    echo "train rc=$? at $(date +%H:%M:%S)"
     exit 0
   fi
   echo "watch $i: wedged at $(date +%H:%M:%S)"
