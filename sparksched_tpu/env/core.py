@@ -460,6 +460,22 @@ def _fulfill_commitment_phase_a(
     return lax.cond(dj < 0, to_common, to_stage, state)
 
 
+def _exec_scatter(sel):
+    """Masked per-executor scatter helpers over a [candidate, executor]
+    selection matrix in which every executor is selected at most once
+    (shared by the bulk passes)."""
+
+    def exset(base, cond, payload):
+        msel = sel & cond[:, None]
+        val = jnp.where(msel, payload[:, None], 0).sum(0)
+        return jnp.where(msel.any(0), val.astype(base.dtype), base)
+
+    def exflag(base, cond, value):
+        return jnp.where((sel & cond[:, None]).any(0), value, base)
+
+    return exset, exflag
+
+
 def _bulk_fulfill(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
     num_idle: jnp.ndarray, exec_order: jnp.ndarray,
@@ -573,14 +589,7 @@ def _bulk_fulfill(
 
     # ---- per-executor scatters (each candidate's executor is unique)
     sel = prefix[:, None] & (e[:, None] == pos[None, :])  # [cand, exec]
-
-    def exset(base, cond, payload):
-        msel = sel & cond[:, None]
-        val = jnp.where(msel, payload[:, None], 0).sum(0)
-        return jnp.where(msel.any(0), val.astype(base.dtype), base)
-
-    def exflag(base, cond, value):
-        return jnp.where((sel & cond[:, None]).any(0), value, base)
+    exset, exflag = _exec_scatter(sel)
 
     minus1 = jnp.full((n,), -1, _i32)
     exec_stage = exset(
@@ -1165,6 +1174,252 @@ def _bulk_relaunch(
     ), k
 
 
+def _bulk_ready(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    enabled: jnp.ndarray, stop_at_limit: bool = False,
+):
+    """Consume the maximal run of consecutive EXECUTOR_READY events in
+    one vectorized pass. Returns (state, k); callers fall back to the
+    single-event path when k == 0.
+
+    After a send-heavy commitment round, every sent executor arrives at
+    the same `wall + moving_delay` with consecutive seqs — a burst of
+    ready events the one-at-a-time loop pays one iteration each for.
+    An arrival is *simple* (statically classifiable) like a fulfillment
+    candidate: its handler attaches the executor to its destination job
+    and resolves RQ_MOVE locally, so with the destination unsaturated
+    at its turn (rem0 minus earlier prefix starts > 0) it is A_START
+    iff the destination is on the frontier (static — no completions
+    happen mid-run) else A_PARK. The prefix stops at the first
+    saturated-destination arrival (backup search), at any earlier
+    non-ready event (job arrivals and task finishes are competitors —
+    symmetrically, `_bulk_relaunch` treats arrival events as
+    competitors, so the two passes alternate cleanly), at a finish
+    event GENERATED by an earlier prefix start, and right AFTER any
+    arrival that joins the live source pool — such an arrival can
+    raise `num_committable` above 0, and the sequential per-event tail
+    (round_ready / move_and_clear) must run before the next event,
+    which the caller's tail does when the joiner ends the pass.
+
+    Matches the sequential path bit-exactly except the rng stream.
+    """
+    n = state.exec_job.shape[0]
+    j_cap, s_cap = state.stage_remaining.shape
+    pos = jnp.arange(n)
+
+    # earliest non-ready competitor, lexicographic (time, seq)
+    t_job = jnp.where(state.job_arrived, INF, state.job_arrival_time)
+    jt = t_job.min()
+    jseq = jnp.where(t_job == jt, state.job_arrival_seq, BIG_SEQ).min()
+    ft = state.exec_finish_time.min()
+    fseq = jnp.where(
+        state.exec_finish_time == ft, state.exec_finish_seq, BIG_SEQ
+    ).min()
+    t_star = jnp.minimum(jt, ft)
+    seq_star = jnp.minimum(
+        jnp.where(jt == t_star, jseq, BIG_SEQ),
+        jnp.where(ft == t_star, fseq, BIG_SEQ),
+    )
+
+    # arrivals in processing order
+    gt = (
+        state.exec_arrive_time[:, None] > state.exec_arrive_time[None, :]
+    ) | (
+        (state.exec_arrive_time[:, None]
+         == state.exec_arrive_time[None, :])
+        & (state.exec_arrive_seq[:, None] > state.exec_arrive_seq[None, :])
+    )
+    rank = gt.sum(-1)
+    perm = rank[None, :] == pos[:, None]
+
+    def by_pos(x):
+        return jnp.where(perm, x[None, :], 0).sum(-1)
+
+    to = jnp.where(perm, state.exec_arrive_time[None, :], INF).min(-1)
+    so = by_pos(state.exec_arrive_seq)
+    e = by_pos(pos)
+    dj = by_pos(state.exec_dst_job)
+    ds0 = by_pos(state.exec_dst_stage)
+    djc = jnp.clip(dj, 0, j_cap - 1)
+    dsc = jnp.clip(ds0, 0, s_cap - 1)
+
+    frontier_k = state.frontier[djc, dsc]
+    flat = djc * s_cap + dsc
+    earlier = pos[None, :] < pos[:, None]
+    stage_pair = flat[None, :] == flat[:, None]
+    # within a prefix nobody is saturated, so starts are static; the
+    # per-candidate quantities below may count ALL earlier positions
+    # rather than earlier prefix members — for an in-prefix candidate
+    # the two coincide (the prefix is contiguous), and out-of-prefix
+    # values are never consumed
+    start0 = frontier_k
+    cum_starts = (earlier & stage_pair & start0[None, :]).sum(-1)
+    rem0 = state.stage_remaining[djc, dsc]
+    saturated = rem0 - cum_starts == 0
+
+    same_job = dj[None, :] == dj[:, None]
+    base_nl = (state.exec_job[None, :] == dj[:, None]).sum(-1)
+    # the arriving executor itself plus earlier arrivals to the same
+    # job join the count the sequential `_apply_action` reads after
+    # its handler ran
+    nl = base_nl + (earlier & same_job).sum(-1) + 1
+
+    rng_next, sub = jax.random.split(state.rng)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(sub, pos)
+    tpl = state.job_template[djc]
+    tv = state.exec_task_valid[jnp.clip(e, 0, n - 1)]
+    ss_same = state.exec_task_stage[jnp.clip(e, 0, n - 1)] == ds0
+    durs = jax.vmap(
+        lambda key, tp, s_, nl_, tv_, sm_: sample_task_duration(
+            params, bank, key, tp, s_, nl_, tv_, sm_,
+        )
+    )(keys, tpl, dsc, nl, tv, ss_same)
+    fin_k = to + durs
+
+    before_star = (to < t_star) | ((to == t_star) & (so < seq_star))
+    # an earlier prefix start GENERATES a finish event; the sequential
+    # loop pops it before any later-timed arrival (ties go to the
+    # arrival — generated seqs exceed all pending ones), so the run
+    # must stop there
+    gen = jnp.where(start0, fin_k, INF)
+    gen_before = jnp.concatenate(
+        [jnp.full((1,), INF), lax.cummin(gen)[:-1]]
+    )
+    # an arrival that joins the LIVE source pool can raise
+    # num_committable above 0; the sequential per-event tail reacts
+    # (round_ready or move_and_clear) before the next event, so such
+    # an arrival must be the LAST one this pass consumes — the
+    # caller's tail then runs exactly where the sequential one would
+    joins_source = (
+        state.source_valid
+        & (dj == state.source_job)
+        & jnp.where(
+            start0, ds0 == state.source_stage, state.source_stage == -1
+        )
+    )
+    joined_before = (
+        jnp.concatenate(
+            [jnp.zeros(1, bool), joins_source[:-1]]
+        ).cumsum() > 0
+    )
+    ok = (
+        jnp.isfinite(to)
+        & before_star
+        & ~saturated
+        & (to <= gen_before)
+        & ~joined_before
+    )
+    if stop_at_limit:
+        crossed_before = (
+            jnp.concatenate(
+                [jnp.zeros(1, bool), (to >= state.time_limit)[:-1]]
+            ).cumsum() > 0
+        )
+        ok &= ~crossed_before
+    prefix = (jnp.cumsum((~ok).astype(_i32)) == 0) & jnp.asarray(
+        enabled, bool
+    )
+    k = prefix.sum().astype(_i32)
+
+    start = start0 & prefix
+    park = ~start0 & prefix
+    newly_exh = start & (rem0 - cum_starts == 1)
+
+    inc = start.astype(_i32)
+    seq_k = state.seq_counter + (earlier & start0[None, :]).sum(-1)
+
+    # ---- per-executor scatters
+    sel = prefix[:, None] & perm
+    exset, exflag = _exec_scatter(sel)
+
+    minus1 = jnp.full((n,), -1, _i32)
+    arrived = prefix
+    exec_moving = exflag(state.exec_moving, arrived, False)
+    exec_arrive_time = exset(
+        state.exec_arrive_time, arrived, jnp.full((n,), INF)
+    )
+    exec_at_common = exflag(state.exec_at_common, arrived, False)
+    exec_job = exset(state.exec_job, arrived, dj)
+    exec_stage = exset(
+        state.exec_stage, arrived, jnp.where(start, ds0, minus1)
+    )
+    exec_task_valid = exflag(
+        exflag(state.exec_task_valid, park, False), start, True
+    )
+    exec_executing = exflag(state.exec_executing, start, True)
+    exec_task_stage = exset(state.exec_task_stage, start, ds0)
+    exec_finish_time = exset(state.exec_finish_time, start, fin_k)
+    exec_finish_seq = exset(state.exec_finish_seq, start, seq_k)
+
+    # ---- per-stage counters (every prefix arrival was counted moving)
+    oh_j = (dj[:, None] == jnp.arange(j_cap)[None, :]) & prefix[:, None]
+    oh_s = ds0[:, None] == jnp.arange(s_cap)[None, :]
+    m3 = oh_j[:, :, None] & oh_s[:, None, :]
+    cnt_arr = m3.sum(0).astype(_i32)
+    cnt_start = (m3 & start[:, None, None]).sum(0).astype(_i32)
+    moving_count = state.moving_count - cnt_arr
+    stage_remaining = state.stage_remaining - cnt_start
+    stage_executing = state.stage_executing + cnt_start
+
+    later = pos[None, :] > pos[:, None]
+    is_last_start = start & ~(later & stage_pair & start[None, :]).any(-1)
+    dur_js = (
+        (m3 & is_last_start[:, None, None]) * durs[:, None, None]
+    ).sum(0)
+    stage_duration = jnp.where(
+        cnt_start > 0, dur_js, state.stage_duration
+    )
+    job_saturated_stages = (
+        state.job_saturated_stages
+        + (oh_j & newly_exh[:, None]).sum(0).astype(_i32)
+    )
+
+    # ---- saturation-cache refresh for touched destination stages
+    aff = cnt_arr > 0
+    demand = stage_remaining - moving_count - state.commit_count
+    sat_new = demand <= 0
+    is_rep = prefix & ~(earlier & stage_pair).any(-1)
+    delta_k = jnp.where(
+        is_rep & state.stage_exists[djc, dsc],
+        sat_new[djc, dsc].astype(_i32)
+        - state.stage_sat[djc, dsc].astype(_i32),
+        0,
+    )
+    adj_row = state.adj[djc, dsc]
+    unsat = state.unsat_parent_count - (
+        oh_j[:, :, None]
+        * (delta_k[:, None] * adj_row.astype(_i32))[:, None, :]
+    ).sum(0)
+
+    bulked = k > 0
+    wall = jnp.where(
+        bulked, jnp.where(prefix, to, -INF).max(), state.wall_time
+    )
+    state = state.replace(
+        rng=jnp.where(bulked, rng_next, state.rng),
+        wall_time=wall,
+        seq_counter=state.seq_counter + inc.sum(),
+        exec_moving=exec_moving,
+        exec_arrive_time=exec_arrive_time,
+        exec_at_common=exec_at_common,
+        exec_job=exec_job,
+        exec_stage=exec_stage,
+        exec_task_valid=exec_task_valid,
+        exec_executing=exec_executing,
+        exec_task_stage=exec_task_stage,
+        exec_finish_time=exec_finish_time,
+        exec_finish_seq=exec_finish_seq,
+        moving_count=moving_count,
+        stage_remaining=stage_remaining,
+        stage_executing=stage_executing,
+        stage_duration=stage_duration,
+        job_saturated_stages=job_saturated_stages,
+        stage_sat=jnp.where(aff, sat_new, state.stage_sat),
+        unsat_parent_count=unsat,
+    )
+    return state, k
+
+
 def _resume_simulation(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
     active: jnp.ndarray, bulk: bool = True, bulk_events: int = 8
@@ -1181,11 +1436,12 @@ def _resume_simulation(
 
     def body(st: EnvState) -> EnvState:
         if bulk:
-            st, nb = _bulk_relaunch(
+            st, nb1 = _bulk_relaunch(
                 params, bank, st, jnp.bool_(True),
                 max_events=bulk_events,
             )
-            single = nb == 0
+            st, nb2 = _bulk_ready(params, bank, st, jnp.bool_(True))
+            single = (nb1 + nb2) == 0
         else:
             single = jnp.bool_(True)
         _, t, kind, arg = _next_event(params, st)

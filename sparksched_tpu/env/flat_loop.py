@@ -37,6 +37,7 @@ from .core import (
     _onehot2,
     _add_commitment,
     _apply_action,
+    _bulk_ready,
     _bulk_relaunch,
     _commit_remaining,
     _fulfill_commitment_phase_a,
@@ -143,10 +144,19 @@ def micro_step(
     k_pol, k_reset = jax.random.split(rng)
     ls0 = ls  # pre-bulk state: the freeze path must restore exactly this
     if event_bulk:
-        env_b, nb = _bulk_relaunch(
+        env_b, nb1 = _bulk_relaunch(
             params, bank, ls.env, ls.mode == M_EVENT,
             stop_at_limit=True, max_events=bulk_events,
         )
+        # chain the arrival-burst pass; never past an episode-limit
+        # crossing the cascade just committed (the freeze point)
+        env_b, nb2 = _bulk_ready(
+            params, bank, env_b,
+            (ls.mode == M_EVENT)
+            & (env_b.wall_time < env_b.time_limit),
+            stop_at_limit=True,
+        )
+        nb = nb1 + nb2
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
     else:
         nb = _i32(0)
@@ -382,10 +392,16 @@ def event_micro_step(
 
     ls0 = ls.replace(mode=_i32(M_EVENT))  # pre-bulk state for the tail
     if event_bulk:
-        env_b, nb = _bulk_relaunch(
+        env_b, nb1 = _bulk_relaunch(
             params, bank, ls.env, is_event,
             stop_at_limit=True, max_events=bulk_events,
         )
+        env_b, nb2 = _bulk_ready(
+            params, bank, env_b,
+            is_event & (env_b.wall_time < env_b.time_limit),
+            stop_at_limit=True,
+        )
+        nb = nb1 + nb2
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
         pop_on = is_event & (nb == 0)
     else:

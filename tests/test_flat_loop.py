@@ -212,12 +212,25 @@ def test_run_flat_loop_state_resume_matches_single_run():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_bulk_paths_match_sequential_on_synthetic_bank(monkeypatch):
+@pytest.mark.parametrize(
+    "dur_scale,moving_delay",
+    [
+        (1.0, 2000.0),
+        # tiny durations + short moving delay force dense interleavings
+        # of relaunch-generated finishes with arrival bursts (the
+        # _bulk_ready generated-finish and source-join stop conditions)
+        (0.02, 700.0),
+    ],
+)
+def test_bulk_paths_match_sequential_on_synthetic_bank(
+    monkeypatch, dur_scale, moving_delay
+):
     """Randomized coverage beyond the hand-built fixtures: drive the
     synthetic TPC-H bank (50-job cap, rich DAG/task-count variety) with
     the duration sampler pinned to a deterministic table lookup, so the
-    bulk fast paths (relaunch cascade + fulfillment prefix) must match
-    the fully sequential engine bit-for-bit over whole episodes."""
+    bulk fast paths (relaunch cascade + fulfillment prefix + arrival
+    bursts) must match the fully sequential engine bit-for-bit over
+    whole episodes."""
     import jax
     import jax.numpy as jnp
 
@@ -229,7 +242,7 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(monkeypatch):
 
     def det_sampler(params, bank, rng, template, stage, num_local,
                     task_valid, same_stage):
-        base = bank.rough_duration[template, stage]
+        base = bank.rough_duration[template, stage] * dur_scale
         # distinct per (stage-continuation kind) so wave logic still
         # shapes trajectories, but with no rng sensitivity
         return (
@@ -242,7 +255,7 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(monkeypatch):
 
     params = EnvParams(
         num_executors=6, max_jobs=12, max_stages=20, max_levels=20,
-        moving_delay=2000.0, warmup_delay=1000.0,
+        moving_delay=moving_delay, warmup_delay=1000.0,
         job_arrival_rate=4e-5, mean_time_limit=None,
     )
     bank = make_workload_bank(params.num_executors, params.max_stages)
