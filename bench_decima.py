@@ -18,6 +18,7 @@ rollout/PPO pipeline (trainer.py:85-162); neither publishes numbers
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -124,6 +125,9 @@ def bench_ppo(num_envs: int = 1024, rollout_steps: int = 256) -> None:
         "opt_kwargs": {"lr": 3.0e-4},
         "max_grad_norm": 0.5,
         "rollout_steps": rollout_steps,
+        # match the shipped flagship config (and bench.py's default);
+        # BENCH_PRNG=threefry overrides, as in bench.py
+        "fast_prng": os.environ.get("BENCH_PRNG", "rbg") == "rbg",
     }
     trainer = PPO(cfg_agent, cfg_env, cfg_train)
     state = trainer.init_state()
@@ -161,8 +165,12 @@ if __name__ == "__main__":
         honor_jax_platforms_env,
     )
 
+    from sparksched_tpu.config import use_fast_prng
+
     honor_jax_platforms_env()
     enable_compilation_cache()
+    if os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+        use_fast_prng()
     bench_inference()
     bench_inference(compute_dtype="bfloat16")
     bench_ppo()
