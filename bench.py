@@ -204,6 +204,9 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     value = (total - base) / dt
+    # the trailing config keys make every recorded BENCH_r*.json
+    # self-describing (burst/bulk/PRNG defaults have changed across
+    # rounds; numbers are only comparable at equal config)
     print(
         json.dumps(
             {
@@ -214,6 +217,15 @@ def main() -> None:
                 "value": round(value, 1),
                 "unit": "steps/s",
                 "vs_baseline": round(value / TARGET, 3),
+                "config": {
+                    "num_envs": NUM_ENVS,
+                    "sub_batch": SUB_BATCH,
+                    "burst": BURST,
+                    "bulk_events": int(bulk_events),
+                    "bulk_events_calibrated": BULK_EVENTS is None,
+                    "prng_impl": str(jax.config.jax_default_prng_impl),
+                    "backend": jax.default_backend(),
+                },
             }
         )
     )

@@ -88,6 +88,11 @@ def bench_inference(
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
+        "config": {
+            "num_envs": num_envs,
+            "prng_impl": str(jax.config.jax_default_prng_impl),
+            "backend": jax.default_backend(),
+        },
     }), flush=True)
 
 
@@ -156,6 +161,12 @@ def bench_ppo(num_envs: int = 1024, rollout_steps: int = 256) -> None:
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
+        "config": {
+            "num_envs": num_envs,
+            "rollout_steps": rollout_steps,
+            "prng_impl": str(jax.config.jax_default_prng_impl),
+            "backend": jax.default_backend(),
+        },
     }), flush=True)
 
 

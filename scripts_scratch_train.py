@@ -1,0 +1,94 @@
+"""From-scratch PPO training runner (no warm start) — the capability the
+reference is named for (its models/decima/model.pt is the output of its
+own trainers, README.md:5-7).
+
+Round-3 recipe vs the round-2 run that failed to learn
+(artifacts_train_log.txt: no trend over 100 iterations):
+- reference-parity lane layout: 4 sequences x 4 rollouts (the round-2 run
+  used 2x4; reference config/decima_tpch.yaml:11-18),
+- entropy anneal 0.04 -> 0.005 (the fixed 0.04 bonus on a *normalized*
+  entropy keeps the policy near-uniform at small scale),
+- lr anneal 3e-4 -> 1e-4 over the optimizer steps of ~500 iterations,
+- many more iterations (the reference trains 500; round 2 stopped at 100).
+
+Resumable: sessions save/restore the full train state. Usage:
+  python scripts_scratch_train.py [sessions] [iters_per_session] [tag]
+Artifacts under artifacts/decima_scratch_<tag>; eval with
+scripts_eval_decima.py against the written checkpoint.
+"""
+
+import os.path as osp
+import sys
+
+sys.path.insert(0, "/root/repo")
+from sparksched_tpu.config import (  # noqa: E402
+    enable_compilation_cache,
+    honor_jax_platforms_env,
+)
+
+honor_jax_platforms_env()
+enable_compilation_cache()
+
+from flax import serialization  # noqa: E402
+import jax  # noqa: E402
+
+from sparksched_tpu.trainers import make_trainer  # noqa: E402
+
+
+def make_cfg(tag: str, iters: int) -> dict:
+    return {
+        "trainer": {
+            "trainer_cls": "PPO", "num_iterations": iters,
+            "num_sequences": 4, "num_rollouts": 4, "seed": 42,
+            "artifacts_dir": f"/root/repo/artifacts/decima_scratch_{tag}",
+            "checkpointing_freq": 25, "use_tensorboard": False,
+            "num_epochs": 3, "num_batches": 10, "clip_range": 0.2,
+            "target_kl": 0.01, "entropy_coeff": 0.04,
+            "entropy_anneal": {"final": 0.005, "iterations": 400},
+            "beta_discount": 5.0e-3,
+            "opt_cls": "Adam", "opt_kwargs": {"lr": 3.0e-4},
+            "lr_anneal": {"final": 1.0e-4, "steps": 15000},
+            "max_grad_norm": 0.5, "rollout_steps": 600,
+            "profiling": True,
+        },
+        "agent": {
+            "agent_cls": "DecimaScheduler", "embed_dim": 16,
+            "gnn_mlp_kwargs": {
+                "hid_dims": [32, 16], "act_cls": "LeakyReLU",
+                "act_kwargs": {"negative_slope": 0.2},
+            },
+            "policy_mlp_kwargs": {"hid_dims": [64, 64], "act_cls": "Tanh"},
+        },
+        "env": {
+            "num_executors": 10, "job_arrival_cap": 20,
+            "moving_delay": 2000.0, "mean_time_limit": 2.0e7,
+            "job_arrival_rate": 4.0e-5, "warmup_delay": 1000.0,
+        },
+    }
+
+
+def run(sessions: int, iters: int, tag: str = "r3") -> None:
+    cfg = make_cfg(tag, iters)
+    art = cfg["trainer"]["artifacts_dir"]
+    resume = osp.join(art, "train_state.msgpack")
+    out = f"/root/repo/models/decima/model_scratch_{tag}.msgpack"
+    for s in range(sessions):
+        t = make_trainer(cfg)
+        state = t.train(
+            resume_from=resume if osp.isfile(resume) else None
+        )
+        with open(out, "wb") as fp:
+            fp.write(serialization.to_bytes(jax.device_get(state.params)))
+        print(
+            f"session {s + 1}/{sessions} done at iteration "
+            f"{int(state.iteration)} -> {out}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    run(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 20,
+        int(sys.argv[2]) if len(sys.argv) > 2 else 25,
+        sys.argv[3] if len(sys.argv) > 3 else "r3",
+    )

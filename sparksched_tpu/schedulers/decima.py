@@ -178,20 +178,28 @@ class DecimaNet(nn.Module):
     # precision; scores are returned as f32 either way.
     compute_dtype: str | None = None
 
-    @nn.compact
-    def __call__(self, f: DecimaFeatures):
+    def setup(self) -> None:
+        # setup() (not @nn.compact) so the level loop can be an nn.scan
+        # over a method; attribute names keep the param tree identical to
+        # the round-1/2 checkpoints ("mlp_prep", "mlp_msg", ...).
         g_act = make_act(self.gnn_act, self.gnn_act_kwargs)
-        p_act = make_act(self.policy_act, self.policy_act_kwargs)
-        d = self.embed_dim
+        self._p_act = make_act(self.policy_act, self.policy_act_kwargs)
         cdt = (
             jnp.dtype(self.compute_dtype) if self.compute_dtype else None
         )
+        self._cdt = cdt
+        d = self.embed_dim
+        self.mlp_prep = MLP(self.gnn_hid, d, g_act, dtype=cdt)
+        self.mlp_msg = MLP(self.gnn_hid, d, g_act, dtype=cdt)
+        self.mlp_update = MLP(self.gnn_hid, d, g_act, dtype=cdt)
+        self.mlp_dag = MLP(self.gnn_hid, d, g_act, dtype=cdt)
+        self.mlp_glob = MLP(self.gnn_hid, d, g_act, dtype=cdt)
+        self.mlp_stage = MLP(self.policy_hid, 1, self._p_act, dtype=cdt)
+        self.mlp_exec = MLP(self.policy_hid, 1, self._p_act, dtype=cdt)
 
-        mlp_prep = MLP(self.gnn_hid, d, g_act, name="mlp_prep", dtype=cdt)
-        mlp_msg = MLP(self.gnn_hid, d, g_act, name="mlp_msg", dtype=cdt)
-        mlp_update = MLP(
-            self.gnn_hid, d, g_act, name="mlp_update", dtype=cdt
-        )
+    def __call__(self, f: DecimaFeatures):
+        d = self.embed_dim
+        cdt = self._cdt
 
         # --- NodeEncoder (reference scheduler.py:173-241) ---
         # h[leaf] = update(prep(x)); h[p] = prep(x)[p] + update(sum_children
@@ -199,37 +207,44 @@ class DecimaNet(nn.Module):
         # deepest level up (reverse_flow=True, leaf-to-root).
         x = f.x.astype(cdt) if cdt is not None else f.x
         s_cap = x.shape[-2]
-        h_init = mlp_prep(x)
+        h_init = self.mlp_prep(x)
         adj_f = f.adj.astype(h_init.dtype)
         has_child = f.adj.any(axis=-1)
-        h0 = jnp.where(has_child[..., None], 0.0, mlp_update(h_init))
+        h0 = jnp.where(has_child[..., None], 0.0, self.mlp_update(h_init))
 
-        # static unrolled loop over topological generations, deepest first:
-        # flax modules cannot be called inside a raw lax.scan body, and with
-        # s_cap <= ~20 the unrolled chain of tiny batched matmuls is what
-        # XLA would emit anyway.
-        h_node = h0
-        for lvl in range(s_cap - 1, -1, -1):
-            agg = jnp.einsum("...pc,...cd->...pd", adj_f, mlp_msg(h_node))
+        # one `nn.scan` step per topological generation, deepest first.
+        # Weights are broadcast across levels (the reference reuses the
+        # same msg/update MLPs each level, scheduler.py:219-232); scanning
+        # instead of statically unrolling keeps the compiled program one
+        # body regardless of s_cap — at the flagship 200-job scale the
+        # unrolled chain dominated XLA compile time.
+        def level_step(mdl, h_node, lvl):
+            agg = jnp.einsum(
+                "...pc,...cd->...pd", adj_f, mdl.mlp_msg(h_node)
+            )
             upd = (f.node_level == lvl) & has_child
             h_node = jnp.where(
-                upd[..., None], h_init + mlp_update(agg), h_node
+                upd[..., None], h_init + mdl.mlp_update(agg), h_node
             )
+            return h_node, None
+
+        levels = jnp.arange(s_cap - 1, -1, -1)
+        h_node, _ = nn.scan(
+            level_step,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+        )(self, h0, levels)
         # reference fast path when the whole batch has no edges
         # (scheduler.py:205-207,236-241): plain prep(x), no update()
         h_node = jnp.where(f.adj.any(), h_node, h_init)
         h_node = jnp.where(f.node_mask[..., None], h_node, 0.0)
 
         # --- DagEncoder (reference scheduler.py:244-257) ---
-        z = MLP(self.gnn_hid, d, g_act, name="mlp_dag", dtype=cdt)(
-            jnp.concatenate([x, h_node], axis=-1)
-        )
+        z = self.mlp_dag(jnp.concatenate([x, h_node], axis=-1))
         h_dag = jnp.where(f.node_mask[..., None], z, 0.0).sum(axis=-2)
 
         # --- GlobalEncoder (reference scheduler.py:260-276) ---
-        zg = MLP(
-            self.gnn_hid, d, g_act, name="mlp_glob", dtype=cdt
-        )(h_dag)
+        zg = self.mlp_glob(h_dag)
         h_glob = jnp.where(f.job_mask[..., None], zg, 0.0).sum(axis=-2)
 
         # --- StagePolicyNetwork (reference scheduler.py:279-320) ---
@@ -243,9 +258,7 @@ class DecimaNet(nn.Module):
         stage_in = jnp.concatenate(
             [x, h_node, h_dag_rpt, h_glob_rpt], axis=-1
         )
-        stage_scores = MLP(
-            self.policy_hid, 1, p_act, name="mlp_stage", dtype=cdt
-        )(stage_in)[..., 0].astype(jnp.float32)
+        stage_scores = self.mlp_stage(stage_in)[..., 0].astype(jnp.float32)
 
         # --- ExecPolicyNetwork (reference scheduler.py:323-385) ---
         # x_dag = first NUM_DAG_FEATURES features of each dag's first node;
@@ -273,9 +286,7 @@ class DecimaNet(nn.Module):
             ],
             axis=-1,
         )
-        exec_scores = MLP(
-            self.policy_hid, 1, p_act, name="mlp_exec", dtype=cdt
-        )(exec_in)[..., 0].astype(jnp.float32)
+        exec_scores = self.mlp_exec(exec_in)[..., 0].astype(jnp.float32)
 
         return stage_scores, exec_scores
 

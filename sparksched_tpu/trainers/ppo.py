@@ -54,6 +54,9 @@ class PPO(Trainer):
         )
         B, T = ro.reward.shape
         bt = B * T
+        ent_coeff = self._entropy_coeff_at(
+            self.entropy_coeff, state.iteration
+        )
         flat = jax.tree_util.tree_map(
             lambda a: a.reshape(bt, *a.shape[2:]), ro.obs
         )
@@ -108,7 +111,7 @@ class PPO(Trainer):
             )
             policy_loss = -_masked_mean(jnp.minimum(pl1, pl2), w, n)
             entropy_loss = -_masked_mean(entropies, w, n)
-            loss = policy_loss + self.entropy_coeff * entropy_loss
+            loss = policy_loss + ent_coeff * entropy_loss
             kl = _masked_mean((ratio - 1) - log_ratio, w, n)
             return loss, {
                 "policy_loss": policy_loss,

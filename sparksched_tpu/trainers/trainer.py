@@ -60,10 +60,20 @@ class TrainState(struct.PyTreeNode):
 
 def make_optimizer(train_cfg: CfgType) -> optax.GradientTransformation:
     """Adam + global-norm clipping (reference scheduler.py:37-54,
-    decima_tpch.yaml:60-63)."""
+    decima_tpch.yaml:60-63). Optional `lr_anneal: {final, steps}`
+    geometrically decays the learning rate over optimizer steps — a
+    training-stability lever beyond the reference's fixed lr."""
     opt_cls = train_cfg.get("opt_cls", "Adam").lower()
     kwargs = dict(train_cfg.get("opt_kwargs") or {})
     lr = float(kwargs.pop("lr", 3e-4))
+    anneal = train_cfg.get("lr_anneal")
+    if anneal:
+        final = float(anneal["final"])
+        steps = int(anneal["steps"])
+        lr = optax.exponential_decay(
+            init_value=lr, transition_steps=steps,
+            decay_rate=final / lr, end_value=final,
+        )
     makers = {
         "adam": optax.adam,
         "adamw": optax.adamw,
@@ -107,6 +117,36 @@ class Trainer(abc.ABC):
         rd = train_cfg.get("rollout_duration")
         # YAML exponent literals without a sign ("2.0e7") arrive as strings
         self.rollout_duration = float(rd) if rd is not None else None
+
+        # training-stability levers beyond the reference's fixed
+        # hyperparameters (its README credits tuning for stability;
+        # these make the schedule explicit and checkpoint-resumable):
+        # entropy_anneal: {final, iterations} — geometric decay of the
+        # entropy bonus from `entropy_coeff` to `final`;
+        # fixed_sequences: true — train every iteration on the same
+        # `num_sequences` job sequences instead of resampling (lower
+        # gradient variance early in training).
+        self.entropy_anneal = train_cfg.get("entropy_anneal")
+        if self.entropy_anneal and "final" not in self.entropy_anneal:
+            raise ValueError("entropy_anneal requires a 'final' value")
+        if self.entropy_anneal and "iterations" not in self.entropy_anneal:
+            # `num_iterations` counts iterations *per session* while
+            # state.iteration is absolute across resumed sessions, so an
+            # implicit horizon would silently pin the coefficient at
+            # `final` for every session after the first
+            raise ValueError(
+                "entropy_anneal requires an explicit 'iterations' horizon "
+                "(absolute iteration count, spanning resumed sessions)"
+            )
+        self.fixed_sequences = bool(train_cfg.get("fixed_sequences", False))
+        if self.fixed_sequences and self.rollout_duration:
+            # async lanes draw each mid-scan episode from
+            # fold_in(seq_base, reset_count); only the initial reset
+            # would be pinned, so the flag's guarantee cannot hold
+            raise ValueError(
+                "fixed_sequences is only supported in sync mode "
+                "(remove rollout_duration)"
+            )
 
         # per-iteration wall-time reporting + optional device trace of the
         # first iteration (the reference wraps every rollout in cProfile,
@@ -193,6 +233,16 @@ class Trainer(abc.ABC):
             iteration=jnp.zeros((), jnp.int32),
         )
 
+    def _entropy_coeff_at(self, base: float, iteration: jnp.ndarray):
+        """Entropy coefficient at `iteration` under the optional
+        geometric anneal (jit-traceable)."""
+        if not self.entropy_anneal or not base:
+            return base
+        final = float(self.entropy_anneal["final"])
+        n = float(self.entropy_anneal["iterations"])
+        frac = jnp.clip(iteration.astype(jnp.float32) / n, 0.0, 1.0)
+        return base * (final / base) ** frac
+
     def _collect(self, model_params, iteration: jnp.ndarray,
                  rng: jax.Array, env_states) -> tuple[Rollout, Any]:
         """One iteration's rollouts: [B]-vmapped scans. Seed layout mirrors
@@ -201,6 +251,8 @@ class Trainer(abc.ABC):
         p, bank = self.params_env, self.bank
         G, R = self.num_sequences, self.num_rollouts
         master = jax.random.PRNGKey(self.seed)
+        if self.fixed_sequences:
+            iteration = jnp.zeros_like(iteration)
 
         def seq_key(g, reset_count):
             return jax.random.fold_in(
@@ -416,11 +468,40 @@ class Trainer(abc.ABC):
     def save_train_state(self, state: TrainState, path: str) -> None:
         with open(path, "wb") as fp:
             fp.write(serialization.to_bytes(jax.device_get(state)))
+        # the checkpointed rng key's layout depends on the PRNG impl
+        # (threefry uint32[2] vs rbg uint32[4], see config.use_fast_prng);
+        # stamp the impl so a resume under the wrong `fast_prng` setting
+        # fails with an error that names the flag instead of an opaque
+        # flax shape mismatch
+        with open(path + ".meta.json", "w") as fp:
+            json.dump(
+                {"prng_impl": str(jax.config.jax_default_prng_impl)}, fp
+            )
 
     def load_train_state(self, path: str) -> TrainState:
+        current = str(jax.config.jax_default_prng_impl)
+        meta_path = path + ".meta.json"
+        if osp.exists(meta_path):
+            with open(meta_path) as fp:
+                saved = json.load(fp).get("prng_impl", current)
+            if saved != current:
+                raise ValueError(
+                    f"train state {path} was saved under PRNG impl "
+                    f"{saved!r} but this process uses {current!r} — set "
+                    f"`fast_prng: {saved == 'rbg'}` in the trainer config "
+                    "(config.use_fast_prng switches the impl) before "
+                    "resuming"
+                )
         template = self.init_state()
         with open(path, "rb") as fp:
-            return serialization.from_bytes(template, fp.read())
+            try:
+                return serialization.from_bytes(template, fp.read())
+            except ValueError as e:
+                raise ValueError(
+                    f"could not restore {path}: {e} — if the error is a "
+                    "shape mismatch on `rng`, the state was saved under a "
+                    "different PRNG impl (trainer config `fast_prng`)"
+                ) from e
 
     def _write_stats(self, i: int, stats: dict[str, float]) -> None:
         if self._tb is None:

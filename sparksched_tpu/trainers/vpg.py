@@ -58,7 +58,10 @@ class VPG(Trainer):
             lgprobs, entropies = jax.vmap(lane)(ro.obs, actions)
             policy_losses = -(lgprobs * adv * w).sum(-1) / n[:, 0]
             entropy_losses = -(entropies * w).sum(-1) / n[:, 0]
-            losses = policy_losses + self.entropy_coeff * entropy_losses
+            ent_coeff = self._entropy_coeff_at(
+                self.entropy_coeff, state.iteration
+            )
+            losses = policy_losses + ent_coeff * entropy_losses
             return losses.sum(), {
                 "policy_loss": policy_losses.mean(),
                 "entropy_loss": entropy_losses.mean(),
