@@ -66,6 +66,10 @@ BURST = int(os.environ.get("BENCH_BURST", 1))  # event sub-steps per group
 # op-count-vs-step-count trade differs across backends
 _BULK_ENV = os.environ.get("BENCH_BULK_EVENTS")
 BULK_EVENTS = int(_BULK_ENV) if _BULK_ENV is not None else None
+# fulfillment-prefix bulking in the flat loop (core._bulk_fulfill wired
+# into the DECIDE branch); unset -> calibrated alongside bulk_events
+_FB_ENV = os.environ.get("BENCH_FULFILL_BULK")
+FULFILL_BULK = bool(int(_FB_ENV)) if _FB_ENV is not None else None
 MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
 assert NUM_ENVS % SUB_BATCH == 0, (
     f"BENCH_SUB_BATCH={SUB_BATCH} must divide {NUM_ENVS}"
@@ -77,8 +81,9 @@ NUM_CHUNKS = 4
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 
 
-@partial(jax.jit, static_argnums=(0, 4))
-def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events):
+@partial(jax.jit, static_argnums=(0, 4, 5))
+def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events,
+                fulfill_bulk):
     """MICRO_CHUNK flat micro-steps per lane; returns updated loop states
     and the total decision count across the batch."""
 
@@ -91,7 +96,8 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events):
             params, bank, pol, rng, MICRO_CHUNK // BURST,
             auto_reset=False, compute_levels=False, event_burst=BURST,
             event_bulk=bulk_events > 0,
-            bulk_events=max(bulk_events, 1), loop_state=ls,
+            bulk_events=max(bulk_events, 1),
+            fulfill_bulk=fulfill_bulk, loop_state=ls,
         )
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
@@ -155,33 +161,37 @@ def main() -> None:
     states = jax.vmap(lambda k: core.reset(params, bank, k))(reset_keys)
     loop_states = jax.vmap(init_loop_state)(states)
 
-    # warmup/compile (also warms both calibration candidates)
-    cands = [BULK_EVENTS] if BULK_EVENTS is not None else [8, 0]
+    # warmup/compile (also warms every calibration candidate)
+    be_cands = [BULK_EVENTS] if BULK_EVENTS is not None else [8, 0]
+    fb_cands = [FULFILL_BULK] if FULFILL_BULK is not None else [True, False]
+    cands = [(be, fb) for be in be_cands for fb in fb_cands]
     keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
-    for be in cands:
-        loop_states, n = bench_chunk(params, bank, loop_states, keys, be)
+    for i, (be, fb) in enumerate(cands):
+        loop_states, n = bench_chunk(
+            params, bank, loop_states, keys, be, fb
+        )
         jax.block_until_ready(n)
-        keys = jax.random.split(jax.random.PRNGKey(90 + be), NUM_ENVS)
+        keys = jax.random.split(jax.random.PRNGKey(90 + i), NUM_ENVS)
     if len(cands) > 1:
         rates = {}
-        for be in cands:
-            # re-seed finished lanes before each candidate so both
+        for i, (be, fb) in enumerate(cands):
+            # re-seed finished lanes before each candidate so all
             # measure the same live-lane precondition
             loop_states = reset_done_lanes(
                 params, bank, loop_states,
-                jax.random.split(jax.random.PRNGKey(80 + be), NUM_ENVS),
+                jax.random.split(jax.random.PRNGKey(80 + i), NUM_ENVS),
             )
             d0 = int(jax.block_until_ready(loop_states.decisions.sum()))
-            kk = jax.random.split(jax.random.PRNGKey(70 + be), NUM_ENVS)
+            kk = jax.random.split(jax.random.PRNGKey(70 + i), NUM_ENVS)
             tc = time.perf_counter()
             loop_states, n = bench_chunk(
-                params, bank, loop_states, kk, be
+                params, bank, loop_states, kk, be, fb
             )
             d1 = int(jax.block_until_ready(n))
-            rates[be] = (d1 - d0) / (time.perf_counter() - tc)
-        bulk_events = max(rates, key=rates.get)
+            rates[(be, fb)] = (d1 - d0) / (time.perf_counter() - tc)
+        bulk_events, fulfill_bulk = max(rates, key=rates.get)
     else:
-        bulk_events = cands[0]
+        bulk_events, fulfill_bulk = cands[0]
     # timed run starts from a freshly re-seeded lane population on both
     # the calibrated and the env-pinned paths
     loop_states = reset_done_lanes(
@@ -194,7 +204,7 @@ def main() -> None:
     for i in range(NUM_CHUNKS):
         keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
         loop_states, n = bench_chunk(
-            params, bank, loop_states, keys, bulk_events
+            params, bank, loop_states, keys, bulk_events, fulfill_bulk
         )
         loop_states = reset_done_lanes(
             params, bank, loop_states,
@@ -222,7 +232,9 @@ def main() -> None:
                     "sub_batch": SUB_BATCH,
                     "burst": BURST,
                     "bulk_events": int(bulk_events),
-                    "bulk_events_calibrated": BULK_EVENTS is None,
+                    "fulfill_bulk": bool(fulfill_bulk),
+                    "calibrated": BULK_EVENTS is None
+                    or FULFILL_BULK is None,
                     "prng_impl": str(jax.config.jax_default_prng_impl),
                     "backend": jax.default_backend(),
                 },

@@ -1,10 +1,16 @@
 #!/bin/bash
-# Poll the TPU tunnel; the moment it answers, run the full chip session
-# (benches + flagship check) in this same process slot and exit.
-# Output: /tmp/chip_watch.log
+# Poll the TPU tunnel GENTLY; the moment it answers, run the full chip
+# session (benches incl. the new fulfill_bulk calibration) and then
+# on-chip from-scratch PPO training. Output: /tmp/chip_watch.log
+#
+# Round-3 polling discipline: the round-2 watcher probed every 4 min,
+# each probe a timeout-killed client — 12+ h of continuous wedge under
+# that regime suggests aggressive polling may itself hold the grant.
+# Poll every 20 min with a generous 300 s timeout instead, leaving long
+# no-touch windows for the tunnel to clear.
 cd /root/repo
-for i in $(seq 1 200); do
-  if timeout 120 python -c "
+for i in $(seq 1 40); do
+  if timeout 300 python -c "
 import jax
 jax.config.update('jax_compilation_cache_dir', '/root/repo/.jax_cache')
 import jax.numpy as jnp
@@ -14,12 +20,16 @@ print('ALIVE')
     echo "chip alive at $(date +%H:%M:%S); running session"
     timeout 4500 python scripts_chip_session.py 1 6 3 4 5
     echo "session rc=$? at $(date +%H:%M:%S)"
-    # use remaining chip time for on-chip PPO training sessions
-    # (resumable; scripts_train_loop honors the chip platform default)
-    timeout 5400 python scripts_train_loop.py 20 3
+    # use remaining chip time for on-chip from-scratch PPO training.
+    # The CPU session loop writes the same train state; stop it first
+    # (it saves at each 25-iteration session boundary, so at most one
+    # partial session is lost) and resume its progress on the chip.
+    pkill -f "scripts_scratch_train" 2>/dev/null
+    sleep 5
+    timeout 9000 python scripts_scratch_train.py 40 25 r3
     echo "train rc=$? at $(date +%H:%M:%S)"
     exit 0
   fi
   echo "watch $i: wedged at $(date +%H:%M:%S)"
-  sleep 240
+  sleep 1200
 done

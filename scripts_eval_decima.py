@@ -25,10 +25,16 @@ from sparksched_tpu.schedulers import DecimaScheduler, RoundRobinScheduler
 from sparksched_tpu.trainers.rollout import collect_sync
 from sparksched_tpu.workload import make_workload_bank
 
-# the checkpoint's training scale (scripts_train_session.py env cfg)
-ENV = dict(num_executors=10, max_jobs=20, moving_delay=2000.0,
+import os
+
+# the checkpoint's training scale (scripts_train_session.py env cfg);
+# EVAL_JOBS=50 reruns the table at the reference's demo setting
+# (10 executors / 50 jobs, reference examples.py:15-23) with a
+# proportionally larger decision cap
+_JOBS = int(os.environ.get("EVAL_JOBS", 20))
+ENV = dict(num_executors=10, max_jobs=_JOBS, moving_delay=2000.0,
            warmup_delay=1000.0, job_arrival_rate=4.0e-5)
-STEPS = 600  # decision cap; 20-job episodes finish well under this
+STEPS = int(os.environ.get("EVAL_STEPS", 30 * _JOBS))
 HELD_OUT_BASE = 10_000  # disjoint from training seeds (iteration-indexed)
 
 

@@ -37,6 +37,7 @@ from .core import (
     _onehot2,
     _add_commitment,
     _apply_action,
+    _bulk_fulfill,
     _bulk_ready,
     _bulk_relaunch,
     _commit_remaining,
@@ -134,13 +135,24 @@ def micro_step(
     compute_levels: bool = True,
     event_bulk: bool = True,
     bulk_events: int = 8,
+    fulfill_bulk: bool = False,
 ) -> LoopState:
     """One unit of work for one lane (vmap over lanes). With
     `event_bulk`, an EVENT micro-step consumes a whole run of relaunch
     events via `core._bulk_relaunch` (hoisted above the mode switch —
     it samples task durations, and bank accesses must stay out of
     lane-dependent branches; see core's structural note) and only falls
-    back to the single-event pop when the run is empty."""
+    back to the single-event pop when the run is empty.
+
+    With `fulfill_bulk`, a DECIDE micro-step that finishes a commitment
+    round consumes the fulfillment phase's simple prefix in one
+    `core._bulk_fulfill` pass (exactly `core.step`'s bulk path) and only
+    the backup-scheduling leftovers take FULFILL micro-steps — removing
+    the ~1 FULFILL step per decision the flat loop otherwise pays. Like
+    the relaunch cascade, the pass's op count is charged to every lane
+    on every micro-step under vmap (a batched `lax.switch` executes all
+    branches), so the flag is calibration-gated in bench.py rather than
+    assumed to win."""
     k_pol, k_reset = jax.random.split(rng)
     ls0 = ls  # pre-bulk state: the freeze path must restore exactly this
     if event_bulk:
@@ -210,25 +222,39 @@ def micro_step(
             slot_order = _rank_order(
                 jnp.where(match, st.cm_seq, BIG_SEQ)
             )
-            # empty fulfillment: clear and go straight to events
-            st = lax.cond(
-                num_idle == 0, _clear_round, lambda x: x, st
-            )
-            mode = jnp.where(num_idle == 0, M_EVENT, M_FULFILL)
-            return st, mode.astype(_i32), num_idle, exec_order, slot_order
+            if fulfill_bulk:
+                # one vectorized pass over the phase's simple prefix
+                # (core._fulfill_from_source's bulk path); leftovers
+                # k0..num_idle-1 run as FULFILL micro-steps
+                st, k0 = _bulk_fulfill(
+                    params, bank, st, num_idle, exec_order, slot_order
+                )
+            else:
+                k0 = _i32(0)
+            # phase already complete (empty, or fully consumed by the
+            # bulk pass): clear and go straight to events — matching
+            # core.step, which clears only after _fulfill_from_source
+            # returns (no leftover backup search remains to observe
+            # stage_selected)
+            complete = k0 >= num_idle
+            st = lax.cond(complete, _clear_round, lambda x: x, st)
+            mode = jnp.where(complete, M_EVENT, M_FULFILL)
+            return st, mode.astype(_i32), num_idle, exec_order, \
+                slot_order, k0
 
         def stay(st: EnvState):
             return (
-                st, _i32(M_DECIDE), _i32(0), ls.exec_order, ls.slot_order
+                st, _i32(M_DECIDE), _i32(0), ls.exec_order,
+                ls.slot_order, _i32(0),
             )
 
-        st, mode, num_idle, eo, so = lax.cond(
+        st, mode, num_idle, eo, so, k0 = lax.cond(
             round_continues, stay, finish, st
         )
         return ls.replace(
             env=st,
             mode=mode,
-            fulfill_k=_i32(0),
+            fulfill_k=k0,
             num_idle=num_idle,
             exec_order=eo,
             slot_order=so,
@@ -430,6 +456,7 @@ def run_flat(
     event_burst: int = 1,
     event_bulk: bool = True,
     bulk_events: int = 8,
+    fulfill_bulk: bool = False,
     loop_state: LoopState | None = None,
 ) -> LoopState:
     """Scan `num_groups` micro-step groups for one lane (vmap over
@@ -445,7 +472,7 @@ def run_flat(
         k, sub = jax.random.split(k)
         ls = micro_step(
             params, bank, policy_fn, ls, sub, auto_reset,
-            compute_levels, event_bulk, bulk_events,
+            compute_levels, event_bulk, bulk_events, fulfill_bulk,
         )
         for _ in range(event_burst - 1):
             k, sub = jax.random.split(k)

@@ -466,8 +466,13 @@ class Trainer(abc.ABC):
             json.dump(meta, fp)
 
     def save_train_state(self, state: TrainState, path: str) -> None:
-        with open(path, "wb") as fp:
+        # tmp + atomic rename: session loops get killed (watchdogs,
+        # chip handover) and a truncated in-place write would poison
+        # every later resume
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fp:
             fp.write(serialization.to_bytes(jax.device_get(state)))
+        os.replace(tmp, path)
         # the checkpointed rng key's layout depends on the PRNG impl
         # (threefry uint32[2] vs rbg uint32[4], see config.use_fast_prng);
         # stamp the impl so a resume under the wrong `fast_prng` setting

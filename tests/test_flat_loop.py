@@ -14,11 +14,12 @@ from .reference_fixtures import (
 )
 
 
+@pytest.mark.parametrize("fulfill_bulk", [False, True])
 @pytest.mark.parametrize("burst", [1, 4])
 @pytest.mark.parametrize(
     "spec_fn,num_exec", [(spec_diamond, 4), (lambda: spec_multi_job(4, 11), 5)]
 )
-def test_flat_loop_matches_step_loop(spec_fn, num_exec, burst):
+def test_flat_loop_matches_step_loop(spec_fn, num_exec, burst, fulfill_bulk):
     import jax
     import jax.numpy as jnp
 
@@ -49,6 +50,7 @@ def test_flat_loop_matches_step_loop(spec_fn, num_exec, burst):
         lambda s, r: run_flat(
             params, bank, pol, r, 40 * decisions // burst, s,
             auto_reset=False, event_burst=burst,
+            fulfill_bulk=fulfill_bulk,
         )
     )(state0, jax.random.PRNGKey(0))
 
@@ -287,27 +289,32 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(
                 break
         assert bool(term), f"seed {seed}: episode did not finish"
 
-        # the flat micro-step engine (bench path, single-fulfill steps)
-        # must land on the same terminal state as the per-decision loop
+        # the flat micro-step engine (bench path) must land on the same
+        # terminal state as the per-decision loop — with single-fulfill
+        # micro-steps AND with the bulked fulfillment prefix
         from sparksched_tpu.env.flat_loop import run_flat
 
         def pol(rng, obs):
             si, ne = round_robin_policy(obs, params.num_executors, True)
             return si, ne, {}
 
-        ls = jax.jit(
-            lambda s, r: run_flat(
-                params, bank, pol, r, 6000, s, auto_reset=False,
+        for fb in (False, True):
+            ls = jax.jit(
+                lambda s, r, fb=fb: run_flat(
+                    params, bank, pol, r, 6000, s, auto_reset=False,
+                    fulfill_bulk=fb,
+                )
+            )(core.reset(params, bank, jax.random.PRNGKey(seed)),
+              jax.random.PRNGKey(0))
+            assert int(ls.episodes) == 1, (
+                f"seed {seed} fb={fb}: flat episode open"
             )
-        )(core.reset(params, bank, jax.random.PRNGKey(seed)),
-          jax.random.PRNGKey(0))
-        assert int(ls.episodes) == 1, f"seed {seed}: flat episode open"
-        np.testing.assert_allclose(
-            float(ls.env.wall_time), float(sa.wall_time), rtol=1e-6,
-            err_msg=f"seed {seed}: flat wall_time",
-        )
-        np.testing.assert_allclose(
-            np.asarray(ls.env.job_t_completed),
-            np.asarray(sa.job_t_completed), rtol=1e-6,
-            err_msg=f"seed {seed}: flat job completion times",
-        )
+            np.testing.assert_allclose(
+                float(ls.env.wall_time), float(sa.wall_time), rtol=1e-6,
+                err_msg=f"seed {seed} fb={fb}: flat wall_time",
+            )
+            np.testing.assert_allclose(
+                np.asarray(ls.env.job_t_completed),
+                np.asarray(sa.job_t_completed), rtol=1e-6,
+                err_msg=f"seed {seed} fb={fb}: flat job completion times",
+            )
