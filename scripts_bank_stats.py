@@ -13,7 +13,10 @@ numpy only — safe to run anywhere (no jax / no chip).
 
 import numpy as np
 
-from sparksched_tpu.workload.bank import EXEC_LEVEL_VALUES
+from sparksched_tpu.workload.bank import (
+    EXEC_LEVEL_VALUES,
+    topological_levels,
+)
 from sparksched_tpu.workload.synthetic import make_templates
 
 
@@ -32,14 +35,9 @@ def main() -> None:
     job_tasks = np.array([int(t["num_tasks"].sum()) for t in ts])
     depth = []
     for t in ts:
-        adj = t["adj"]
-        n = adj.shape[0]
-        lvl = np.zeros(n, int)
-        for c in range(n):
-            ps_ = np.flatnonzero(adj[:, c])
-            if ps_.size:
-                lvl[c] = lvl[ps_].max() + 1
-        depth.append(int(lvl.max()) + 1)
+        n = t["num_tasks"].size
+        lvl = topological_levels(np.asarray(t["adj"]), n)
+        depth.append(int(lvl[:n].max()) + 1)
     depth = np.array(depth)
 
     waves = {"fresh_durations": [], "first_wave": [], "rest_wave": []}

@@ -75,9 +75,9 @@ def main() -> None:
             state.params, state.iteration, state.rng, None
         )
         comp_c = lowered_c.compile()
-        ro, _ = t._collect_jit(
-            state.params, state.iteration, state.rng, None
-        )
+        # execute through the AOT-compiled object (a fresh
+        # t._collect_jit call would re-trace and recompile)
+        ro, _ = comp_c(state.params, state.iteration, state.rng, None)
         shard_shape = ro.obs.duration.sharding.shard_shape(
             ro.obs.duration.shape
         )

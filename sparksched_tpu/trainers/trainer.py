@@ -184,8 +184,24 @@ class Trainer(abc.ABC):
             "rollout_steps", 48 * self.params_env.max_jobs
         )
 
+        # bound the Decima level scan by the bank's true max DAG depth
+        # (bit-identical — deeper levels are no-op updates — and the
+        # dominant GNN cost scales with it; the synthetic bank is 6 deep
+        # vs a 20-stage cap). An explicit agent num_levels wins.
+        bank_depth = int(
+            np.max(
+                np.where(
+                    np.asarray(self.bank.node_level)
+                    < self.bank.max_stages,
+                    np.asarray(self.bank.node_level),
+                    -1,
+                )
+            )
+        ) + 1
         scheduler = make_scheduler(
-            agent_cfg | {"num_executors": self.params_env.num_executors}
+            {"num_levels": bank_depth}
+            | agent_cfg
+            | {"num_executors": self.params_env.num_executors}
         )
         assert isinstance(scheduler, TrainableScheduler), (
             "scheduler must be trainable"
@@ -477,11 +493,14 @@ class Trainer(abc.ABC):
         # (threefry uint32[2] vs rbg uint32[4], see config.use_fast_prng);
         # stamp the impl so a resume under the wrong `fast_prng` setting
         # fails with an error that names the flag instead of an opaque
-        # flax shape mismatch
-        with open(path + ".meta.json", "w") as fp:
+        # flax shape mismatch (tmp+replace for the same kill-safety as
+        # the state file)
+        meta_tmp = path + ".meta.json.tmp"
+        with open(meta_tmp, "w") as fp:
             json.dump(
                 {"prng_impl": str(jax.config.jax_default_prng_impl)}, fp
             )
+        os.replace(meta_tmp, path + ".meta.json")
 
     def load_train_state(self, path: str) -> TrainState:
         current = str(jax.config.jax_default_prng_impl)

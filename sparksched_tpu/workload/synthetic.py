@@ -58,8 +58,11 @@ def make_templates(seed: int = 2024, bucket_size: int = 16,
     for q in range(1, NUM_QUERIES + 1):
         struct_rng = np.random.default_rng([seed, q])
         num_stages, adj, num_tasks, base_dur = _query_structure(q, struct_rng)
-        for size in QUERY_SIZES:
-            rng = np.random.default_rng([seed, q, hash(size) % (2**31)])
+        for si, size in enumerate(QUERY_SIZES):
+            # NOT hash(size): Python string hashing is salted per process
+            # (PYTHONHASHSEED), which silently made every process build a
+            # different bank — the index is the deterministic key
+            rng = np.random.default_rng([seed, q, si])
             scale = SIZE_SCALE[size]
             durations = {}
             for s in range(num_stages):

@@ -280,6 +280,47 @@ def test_decima_forward_matches_numpy_replica():
     )
 
 
+def test_decima_depth_bounded_levels_bit_identical():
+    """A `num_levels` bound at the workload bank's true max DAG depth
+    must be bit-identical to scanning all s_cap levels (the skipped
+    levels' update masks are all-false) — the trainer wires this bound
+    automatically from bank.node_level."""
+    import jax
+    import numpy as np_
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.env.observe import observe
+    from sparksched_tpu.schedulers.decima import (
+        DecimaScheduler,
+        build_features,
+    )
+    from sparksched_tpu.workload import make_workload_bank
+
+    params = EnvParams(num_executors=6, max_jobs=6)
+    bank = make_workload_bank(6, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    nl = np_.asarray(bank.node_level)
+    depth = int(np_.max(np_.where(nl < bank.max_stages, nl, -1))) + 1
+    assert 0 < depth < bank.max_stages  # the bound actually bites
+
+    full = DecimaScheduler(num_executors=6)
+    bounded = DecimaScheduler(num_executors=6, num_levels=depth)
+    st = core.reset(params, bank, jax.random.PRNGKey(3))
+    for _ in range(15):
+        obs = observe(params, st)
+        flat = np_.flatnonzero(np_.asarray(obs.schedulable).reshape(-1))
+        si = int(flat[0]) if flat.size else -1
+        st, _, _, _ = core.step(params, bank, st, si, 2)
+    f = build_features(observe(params, st), 6)
+    sa, ea = full.net.apply(full.params, f)
+    sb, eb = bounded.net.apply(bounded.params, f)
+    np_.testing.assert_array_equal(np_.asarray(sa), np_.asarray(sb))
+    np_.testing.assert_array_equal(np_.asarray(ea), np_.asarray(eb))
+
+
 def test_decima_no_edges_fast_path():
     """With zero active edges anywhere, h_node must equal mlp_prep(x)
     (reference scheduler.py:236-241), not mlp_update(mlp_prep(x))."""
