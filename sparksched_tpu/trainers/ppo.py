@@ -3,15 +3,26 @@
 The epochs x shuffled-minibatches loop, clipped surrogate loss, per-batch
 advantage standardization, entropy bonus and approx-KL early stop are all
 inside one jitted `lax.scan` over minibatches, so the whole update is a
-single XLA program. Two deliberate deviations from the reference, both
-forced by static shapes:
+single XLA program. Three deliberate deviations from the reference, the
+first two forced by static shapes, the third by SPMD sharding:
 
 - minibatches are fixed-size slices of a padded permutation, so a batch's
   *effective* size varies slightly (masked means) instead of
   `len(dataset)//num_batches + 1`;
 - the KL early stop zeroes out all subsequent updates in the scan instead
   of Python `break` — identical parameter trajectory, same wasted-compute
-  tradeoff the reference makes when it keeps collecting after stopping.
+  tradeoff the reference makes when it keeps collecting after stopping;
+- minibatches are drawn as per-lane permutations of the TIME axis (every
+  minibatch contains all B lanes x a random T-slice) instead of one
+  global permutation of the flattened B*T dataset. A global shuffle
+  forces XLA to all-gather the whole rollout onto every device of a dp
+  mesh (measured: per-device update FLOPs flat in dp); keeping the lane
+  axis intact lets the minibatch gather, the GNN recompute and the
+  gradient all shard 1/dp, with one psum per grad step — the same
+  reduction structure as the loss means. Identical on a single device
+  modulo minibatch composition (every step still appears exactly once
+  per epoch; advantage standardization stays per-minibatch and global
+  across lanes).
 """
 
 from __future__ import annotations
@@ -53,57 +64,78 @@ class PPO(Trainer):
             self._returns_and_baselines(state, ro)
         )
         B, T = ro.reward.shape
-        bt = B * T
         ent_coeff = self._entropy_coeff_at(
             self.entropy_coeff, state.iteration
         )
-        flat = jax.tree_util.tree_map(
-            lambda a: a.reshape(bt, *a.shape[2:]), ro.obs
-        )
         actions = DecimaAction(
-            stage_idx=ro.stage_idx.reshape(bt),
-            job_idx=ro.job_idx.reshape(bt),
-            num_exec=ro.num_exec_k.reshape(bt),
-        )
-        advantages = (returns - baselines).reshape(bt)
-        old_lgprobs = ro.lgprob.reshape(bt)
-        valid = (ro.valid.reshape(bt)) & (actions.stage_idx >= 0)
+            stage_idx=ro.stage_idx,
+            job_idx=ro.job_idx,
+            num_exec=ro.num_exec_k,
+        )  # [B,T]
+        advantages = returns - baselines  # [B,T]
+        old_lgprobs = ro.lgprob
+        valid = ro.valid & (actions.stage_idx >= 0)
 
-        # shuffled fixed-size minibatches (reference ppo.py:64-71)
+        # shuffled fixed-size minibatches (reference ppo.py:64-71),
+        # shard-aligned: per-lane permutations of the time axis (see
+        # module docstring). mb_idx[k] is i32[B, mbs] — lane b of
+        # minibatch k takes steps mb_idx[k, b, :].
         nb = self.num_batches
-        mbs = -(-bt // nb)
+        mbs = -(-T // nb)
         rng = jax.random.fold_in(state.rng, 13)
-        perms = jax.vmap(
-            lambda k: jax.random.permutation(k, bt)
-        )(jax.random.split(rng, self.num_epochs))
-        pad = nb * mbs - bt
+        lane_keys = jax.vmap(jax.random.split, in_axes=(0, None))(
+            jax.random.split(rng, self.num_epochs), B
+        )  # [E, B, 2]
+        perms = jax.vmap(jax.vmap(lambda k: jax.random.permutation(k, T)))(
+            lane_keys
+        )  # [E, B, T]
+        pad = nb * mbs - T
         perms = jnp.concatenate(
-            [perms, jnp.zeros((self.num_epochs, pad), jnp.int32)], axis=1
+            [perms, jnp.zeros((self.num_epochs, B, pad), jnp.int32)],
+            axis=-1,
         )
-        in_range = jnp.concatenate(
-            [jnp.ones((self.num_epochs, bt), bool),
-             jnp.zeros((self.num_epochs, pad), bool)],
-            axis=1,
+        # [E, B, nb, mbs] -> [E*nb, B, mbs]; ok masks by slot position
+        # (identical across lanes and epochs: slots past T are padding)
+        mb_idx = (
+            perms.reshape(self.num_epochs, B, nb, mbs)
+            .transpose(0, 2, 1, 3)
+            .reshape(self.num_epochs * nb, B, mbs)
         )
-        mb_idx = perms.reshape(self.num_epochs * nb, mbs)
-        mb_ok = in_range.reshape(self.num_epochs * nb, mbs)
+        in_range = jnp.arange(nb * mbs) < T  # [nb*mbs]
+        mb_ok = jnp.tile(
+            in_range.reshape(nb, mbs), (self.num_epochs, 1)
+        )
+
+        def gather_t(a, idx):
+            """a: [B, T, ...], idx: i32[B, m] -> [B, m, ...]."""
+            return jax.vmap(lambda row, ii: row[ii])(a, idx)
 
         def loss_fn(params, idx, ok):
-            so = jax.tree_util.tree_map(lambda a: a[idx], flat)
+            so = jax.tree_util.tree_map(
+                lambda a: gather_t(a, idx).reshape(
+                    B * idx.shape[1], *a.shape[2:]
+                ),
+                ro.obs,
+            )
             feats = self._features(so)
-            acts = jax.tree_util.tree_map(lambda a: a[idx], actions)
+            acts = jax.tree_util.tree_map(
+                lambda a: gather_t(a, idx).reshape(-1), actions
+            )
             lgprobs, entropies = self.scheduler.evaluate_actions(
                 params, feats, acts
             )
-            w = (valid[idx] & ok).astype(jnp.float32)
+            w = (
+                gather_t(valid, idx).reshape(-1)
+                & jnp.tile(ok, (B,))
+            ).astype(jnp.float32)
             n = jnp.maximum(w.sum(), 1.0)
 
-            adv = advantages[idx]
+            adv = gather_t(advantages, idx).reshape(-1)
             mean = _masked_mean(adv, w, n)
             var = ((adv - mean) ** 2 * w).sum() / jnp.maximum(n - 1, 1.0)
             adv = (adv - mean) / (jnp.sqrt(var) + EPS)
 
-            log_ratio = lgprobs - old_lgprobs[idx]
+            log_ratio = lgprobs - gather_t(old_lgprobs, idx).reshape(-1)
             ratio = jnp.exp(log_ratio)
             pl1 = adv * ratio
             pl2 = adv * jnp.clip(
