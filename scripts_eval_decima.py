@@ -113,6 +113,17 @@ PROVENANCE = {
 def main():
     num_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 32
     ckpts = dict(CKPTS)
+    # EVAL_CKPTS: comma-separated substrings selecting which of the
+    # default checkpoints to evaluate (long 50-job runs need not pay
+    # for stale ones)
+    sel = os.environ.get("EVAL_CKPTS")
+    if sel:
+        keys = [s.strip() for s in sel.split(",") if s.strip()]
+        ckpts = {
+            n: p for n, p in ckpts.items()
+            if any(k in n for k in keys)
+        }
+        assert ckpts, f"EVAL_CKPTS={sel!r} matched nothing"
     if len(sys.argv) > 2 and sys.argv[2] != "-":
         ckpts = {"decima": sys.argv[2]}
     out_md = sys.argv[3] if len(sys.argv) > 3 else "EVAL.md"
