@@ -22,7 +22,9 @@ if __name__ == "__main__":
     os.chdir("/root/repo/artifacts")
     for name, ckpt, out in [
         ("fair", None, "gantt_fair.png"),
-        ("decima", "/root/reference/models/decima/model.pt",
+        # the tpu fine-tuned checkpoint — this framework's best model
+        # (EVAL_50.md: beats both fair and the converted reference ckpt)
+        ("decima", "/root/repo/models/decima/model_ft.msgpack",
          "gantt_decima.png"),
     ]:
         sched = examples.make_scheduler(name, ckpt)

@@ -83,6 +83,7 @@ def test_invariants_along_episode(small_setup):
         )
 
 
+@pytest.mark.slow
 def test_vmap_batch_runs(small_setup):
     params, bank = small_setup
     batch = 8

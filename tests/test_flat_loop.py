@@ -14,10 +14,22 @@ from .reference_fixtures import (
 )
 
 
+# fast tier keeps the diamond fixture at burst 1 under BOTH fulfillment
+# modes (False is the library default every non-bench caller uses; True
+# is one of bench.py's self-calibration candidates); the multi-job and
+# burst sweeps run in the slow tier
 @pytest.mark.parametrize("fulfill_bulk", [False, True])
-@pytest.mark.parametrize("burst", [1, 4])
 @pytest.mark.parametrize(
-    "spec_fn,num_exec", [(spec_diamond, 4), (lambda: spec_multi_job(4, 11), 5)]
+    "burst", [1, pytest.param(4, marks=pytest.mark.slow)]
+)
+@pytest.mark.parametrize(
+    "spec_fn,num_exec",
+    [
+        (spec_diamond, 4),
+        pytest.param(
+            lambda: spec_multi_job(4, 11), 5, marks=pytest.mark.slow
+        ),
+    ],
 )
 def test_flat_loop_matches_step_loop(spec_fn, num_exec, burst, fulfill_bulk):
     import jax
@@ -65,6 +77,7 @@ def test_flat_loop_matches_step_loop(spec_fn, num_exec, burst, fulfill_bulk):
     )
 
 
+@pytest.mark.slow
 def test_bulk_relaunch_matches_sequential_event_loop():
     """core.step with bulk relaunch processing must produce bit-identical
     trajectories (modulo the rng field, whose stream legitimately
@@ -100,6 +113,7 @@ def test_bulk_relaunch_matches_sequential_event_loop():
         assert bool(term)
 
 
+@pytest.mark.slow
 def test_bulk_stop_at_limit_matches_single_event_flat_loop():
     """The flat engine freezes at the first micro-step whose state
     crosses the episode time limit; a bulk pass must stop right after
@@ -175,6 +189,7 @@ def test_event_micro_step_leaves_non_event_lanes_untouched():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_run_flat_loop_state_resume_matches_single_run():
     """Chunked runs resuming via `loop_state` (the bench pattern) must
     reach the same final state as one continuous run when the rng only

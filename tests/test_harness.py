@@ -34,6 +34,7 @@ def test_config_loader(tmp_path):
     assert load(cfg_path) == cfg
 
 
+@pytest.mark.slow
 def test_graft_entry_compiles():
     import jax
 
@@ -56,6 +57,7 @@ def test_dryrun_multichip_8_devices():
     g.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_vector_env_steps_and_autoresets():
     import jax
     import numpy as np

@@ -66,7 +66,11 @@ def _make_trainer(num_rollouts: int, mesh=None):
     return PPO(agent, env, tr, mesh=mesh)
 
 
-@pytest.mark.parametrize("n_dev", [2, 4, 8])
+@pytest.mark.parametrize(
+    "n_dev",
+    [2, pytest.param(4, marks=pytest.mark.slow),
+     pytest.param(8, marks=pytest.mark.slow)],
+)
 def test_rollout_lanes_shard_across_devices(n_dev):
     assert len(jax.devices()) >= n_dev
     mesh = make_mesh(n_dev)
@@ -89,6 +93,7 @@ def test_rollout_lanes_shard_across_devices(n_dev):
     assert spec[0] == DP_AXIS
 
 
+@pytest.mark.slow
 def test_update_jaxpr_contains_cross_device_collectives():
     n_dev = 4
     mesh = make_mesh(n_dev)
@@ -107,6 +112,7 @@ def test_update_jaxpr_contains_cross_device_collectives():
     )
 
 
+@pytest.mark.slow
 def test_mesh_and_single_device_updates_agree():
     n_dev = 4
     mesh = make_mesh(n_dev)
@@ -159,6 +165,7 @@ def test_mesh_and_single_device_updates_agree():
     )
 
 
+@pytest.mark.slow
 def test_host_device_mesh_shards_and_matches_single_device():
     """2-D ("host", "dp") mesh (virtual multi-host): lanes spread over
     all 8 devices of a 2x4 grid, the update still reduces across the

@@ -432,6 +432,7 @@ def test_pretrained_checkpoint_conversion():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_sample_evaluate_consistency():
     """The lgprob returned at sampling time must equal the lgprob
     recomputed by evaluate_actions for the same action, and sampled actions

@@ -377,6 +377,7 @@ def test_collect_async_group_shares_sequences_across_resets():
     assert not (same and same_arrivals)
 
 
+@pytest.mark.slow
 def test_stored_observation_roundtrip_is_exact():
     """An Observation rebuilt from a StoredObs must match the live one
     field-for-field on everything the models read (incl. the recomputed
