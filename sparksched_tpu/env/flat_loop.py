@@ -223,19 +223,22 @@ def micro_step(
                 jnp.where(match, st.cm_seq, BIG_SEQ)
             )
             if fulfill_bulk:
-                # one vectorized pass over the phase's simple prefix
-                # (core._fulfill_from_source's bulk path); leftovers
-                # k0..num_idle-1 run as FULFILL micro-steps
-                st, k0 = _bulk_fulfill(
-                    params, bank, st, num_idle, exec_order, slot_order
-                )
-            else:
-                k0 = _i32(0)
-            # phase already complete (empty, or fully consumed by the
-            # bulk pass): clear and go straight to events — matching
-            # core.step, which clears only after _fulfill_from_source
-            # returns (no leftover backup search remains to observe
-            # stage_selected)
+                # the bulk pass samples durations, and bank accesses
+                # must stay OUT of lane-dependent branches: batching a
+                # cond instantiates branch constants as broadcast
+                # outputs, materializing a per-lane copy of the bank's
+                # [T,S,3,L,K] duration table (a 19 GB HBM allocation at
+                # 512 lanes on the v5e). The pass runs unconditionally
+                # in the shared tail (_finish_micro_step), gated by
+                # mode — exactly like the relaunch cascade above the
+                # switch — along with the complete/clear/mode step.
+                return st, _i32(M_FULFILL), num_idle, exec_order, \
+                    slot_order, _i32(0)
+            k0 = _i32(0)
+            # phase already complete (empty): clear and go straight to
+            # events — matching core.step, which clears only after
+            # _fulfill_from_source returns (no leftover backup search
+            # remains to observe stage_selected)
             complete = k0 >= num_idle
             st = lax.cond(complete, _clear_round, lambda x: x, st)
             mode = jnp.where(complete, M_EVENT, M_FULFILL)
@@ -295,7 +298,8 @@ def micro_step(
         ls.mode, [decide, fulfill, event], ls
     )
     return _finish_micro_step(
-        params, bank, ls0, ls2, rk, rj, rs, e, quirk, k_reset, auto_reset
+        params, bank, ls0, ls2, rk, rj, rs, e, quirk, k_reset, auto_reset,
+        fulfill_bulk=fulfill_bulk,
     )
 
 
@@ -311,11 +315,39 @@ def _finish_micro_step(
     quirk: jnp.ndarray,
     k_reset: jax.Array,
     auto_reset: bool,
+    fulfill_bulk: bool = False,
 ) -> LoopState:
     """Shared micro-step tail: move resolution/application, round clearing
     and readiness, episode end. `ls` is the pre-step state, `ls2` the
-    state after the mode branch ran."""
+    state after the mode branch ran.
+
+    With `fulfill_bulk`, a DECIDE micro-step that just finished a
+    commitment round (mode went DECIDE -> FULFILL) consumes the
+    fulfillment phase's simple prefix here via `core._bulk_fulfill`,
+    hoisted out of the decide branch so the duration table is never a
+    lane-dependent cond operand (see the branch comment in
+    `micro_step.decide.finish`). The pass is a strict state no-op
+    (rng included) for lanes where the gate is off: every scatter in
+    `_bulk_fulfill` is masked by its candidate prefix, which is empty
+    at num_idle=0."""
     st = ls2.env
+
+    if fulfill_bulk:
+        want = (ls.mode == M_DECIDE) & (ls2.mode == M_FULFILL)
+        ni = jnp.where(want, ls2.num_idle, 0)
+        st, k0 = _bulk_fulfill(
+            params, bank, st, ni, ls2.exec_order, ls2.slot_order
+        )
+        # phase complete (empty, or fully consumed by the pass): clear
+        # and go straight to events — matching core.step, which clears
+        # only after _fulfill_from_source returns (no leftover backup
+        # search remains to observe stage_selected)
+        complete = want & (k0 >= ls2.num_idle)
+        st = lax.cond(complete, _clear_round, lambda x: x, st)
+        ls2 = ls2.replace(
+            fulfill_k=jnp.where(want, k0, ls2.fulfill_k).astype(_i32),
+            mode=jnp.where(complete, M_EVENT, ls2.mode).astype(_i32),
+        )
 
     # shared move resolution + application (the only bank access)
     ak, tj, ts = _resolve_action(params, st, rk, e, rj, rs, quirk)
