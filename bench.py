@@ -36,6 +36,7 @@ non-EVENT modes during bursts cost more than the amortization saved.
 from __future__ import annotations
 
 import json
+import sys
 import time
 from functools import partial
 
@@ -66,8 +67,9 @@ BURST = int(os.environ.get("BENCH_BURST", 1))  # event sub-steps per group
 # op-count-vs-step-count trade differs across backends
 _BULK_ENV = os.environ.get("BENCH_BULK_EVENTS")
 BULK_EVENTS = int(_BULK_ENV) if _BULK_ENV is not None else None
-# fulfillment-prefix bulking in the flat loop (core._bulk_fulfill wired
-# into the DECIDE branch); unset -> calibrated alongside bulk_events
+# fulfillment-prefix bulking in the flat loop (core._bulk_fulfill, run
+# in the shared micro-step tail); unset -> calibrated alongside
+# bulk_events
 _FB_ENV = os.environ.get("BENCH_FULFILL_BULK")
 FULFILL_BULK = bool(int(_FB_ENV)) if _FB_ENV is not None else None
 MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
@@ -161,17 +163,36 @@ def main() -> None:
     states = jax.vmap(lambda k: core.reset(params, bank, k))(reset_keys)
     loop_states = jax.vmap(init_loop_state)(states)
 
-    # warmup/compile (also warms every calibration candidate)
+    # warmup/compile (also warms every calibration candidate). A
+    # candidate that fails to compile or run on this backend (e.g. an
+    # HBM-exceeding allocation — the tiled-layout cost of a program
+    # differs across backends) is dropped from calibration instead of
+    # killing the bench; at least one candidate must survive.
     be_cands = [BULK_EVENTS] if BULK_EVENTS is not None else [8, 0]
     fb_cands = [FULFILL_BULK] if FULFILL_BULK is not None else [True, False]
     cands = [(be, fb) for be in be_cands for fb in fb_cands]
     keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
+    ok_cands = []
     for i, (be, fb) in enumerate(cands):
-        loop_states, n = bench_chunk(
-            params, bank, loop_states, keys, be, fb
-        )
-        jax.block_until_ready(n)
+        try:
+            ls_try, n = bench_chunk(
+                params, bank, loop_states, keys, be, fb
+            )
+            jax.block_until_ready(n)
+        except Exception as err:
+            print(
+                f"# bench: candidate bulk_events={be} "
+                f"fulfill_bulk={fb} skipped "
+                f"({type(err).__name__}: {str(err)[:200]})",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            loop_states = ls_try
+            ok_cands.append((be, fb))
         keys = jax.random.split(jax.random.PRNGKey(90 + i), NUM_ENVS)
+    if not ok_cands:
+        raise RuntimeError("bench: every engine configuration failed")
+    cands = ok_cands
     if len(cands) > 1:
         rates = {}
         for i, (be, fb) in enumerate(cands):

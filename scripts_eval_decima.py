@@ -82,7 +82,7 @@ def make_decima(params, ckpt):
 
 
 CKPTS = {
-    "decima (tpu-trained)": "models/decima/model_tpu.msgpack",
+    "decima (tpu-trained, no warm start)": "models/decima/model_tpu.msgpack",
     "decima (tpu fine-tuned)": "models/decima/model_ft.msgpack",
     "decima (reference ckpt, converted)": (
         "/root/reference/models/decima/model.pt"
@@ -92,9 +92,11 @@ CKPTS = {
 # one provenance line per known checkpoint; the report only describes
 # checkpoints it actually evaluated
 PROVENANCE = {
-    "decima (tpu-trained)": (
+    "decima (tpu-trained, no warm start)": (
         "from-scratch PPO in this framework "
-        "(scripts_train_session.py)"
+        "(scripts_scratch_train.py round-3 recipe: entropy/lr anneal, "
+        "4x4 reference-parity lane layout; best-model checkpoint "
+        "through iteration 75, artifacts/decima_scratch_r3)"
     ),
     "decima (tpu fine-tuned)": (
         "PPO fine-tune in this framework warm-started from the "
