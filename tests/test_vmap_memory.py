@@ -64,6 +64,36 @@ def test_vmapped_step_does_not_broadcast_bank(setup):
     assert not _batched_bank_shapes(txt, bank, B)
 
 
+def test_vmapped_flat_loop_does_not_broadcast_bank(setup):
+    """The flat engine's bulk fast paths sample from the bank; they must
+    stay hoisted out of the mode switch / decide branches (regression:
+    _bulk_fulfill inside decide.finish materialized a per-lane 19.4 GB
+    copy of the dur table on the v5e — fixed by running it in the shared
+    micro-step tail, commit 81e77fb)."""
+    import jax
+
+    from sparksched_tpu.env.flat_loop import init_loop_state, run_flat
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+
+    params, bank, states, B = setup
+
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    def lane(ls, rng):
+        return run_flat(
+            params, bank, pol, rng, 2, auto_reset=False,
+            compute_levels=False, event_burst=2, event_bulk=True,
+            bulk_events=8, fulfill_bulk=True, loop_state=ls,
+        )
+
+    ls = jax.vmap(init_loop_state)(states)
+    rngs = jax.random.split(jax.random.PRNGKey(2), B)
+    txt = str(jax.make_jaxpr(jax.vmap(lane))(ls, rngs))
+    assert not _batched_bank_shapes(txt, bank, B)
+
+
 def test_vmapped_async_collect_does_not_broadcast_bank(setup):
     import jax
 
