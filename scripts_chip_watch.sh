@@ -1,15 +1,29 @@
 #!/bin/bash
-# Poll the TPU tunnel GENTLY; the moment it answers, run the full chip
-# session (benches incl. the new fulfill_bulk calibration) and then
-# on-chip from-scratch PPO training. Output: /tmp/chip_watch.log
+# Poll the TPU tunnel GENTLY; whenever it answers, run the chip session
+# (headline bench FIRST -- tunnel windows have been ~45 min, so the
+# driver-gate number must land before anything else), then hand leftover
+# chip time to on-chip from-scratch PPO training. Loops: after a chip
+# episode (or a wedge mid-session) the CPU trainer is restarted and
+# polling resumes. Touch /tmp/stop_chip_watch to make the watcher exit
+# and leave the tunnel free (e.g. before the driver's round-end bench).
 #
 # Round-3 polling discipline: the round-2 watcher probed every 4 min,
-# each probe a timeout-killed client — 12+ h of continuous wedge under
+# each probe a timeout-killed client -- 12+ h of continuous wedge under
 # that regime suggests aggressive polling may itself hold the grant.
-# Poll every 20 min with a generous 300 s timeout instead, leaving long
-# no-touch windows for the tunnel to clear.
+# Poll every 20 min with a generous 300 s timeout instead.
 cd /root/repo
+rm -f /tmp/stop_chip_watch  # consume any stale stop request at launch
+
+restart_cpu_trainer() {
+  if ! pgrep -f "scripts_scratch_train" > /dev/null; then
+    JAX_PLATFORMS=cpu nohup nice -n 10 python scripts_scratch_train.py \
+      40 25 r3 >> /tmp/scratch_train_cpu.log 2>&1 &
+    echo "cpu trainer restarted (pid $!) at $(date +%H:%M:%S)"
+  fi
+}
+
 for i in $(seq 1 40); do
+  [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
   if timeout 300 python -c "
 import jax
 jax.config.update('jax_compilation_cache_dir', '/root/repo/.jax_cache')
@@ -18,18 +32,23 @@ jax.block_until_ready((jnp.ones((256,256)) @ jnp.ones((256,256))).sum())
 print('ALIVE')
 " 2>/dev/null | grep -q ALIVE; then
     echo "chip alive at $(date +%H:%M:%S); running session"
-    timeout 4500 python scripts_chip_session.py 1 6 3 4 5
+    timeout -k 60 4500 python scripts_chip_session.py 1 3 4 5
     echo "session rc=$? at $(date +%H:%M:%S)"
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # use remaining chip time for on-chip from-scratch PPO training.
     # The CPU session loop writes the same train state; stop it first
     # (it saves at each 25-iteration session boundary, so at most one
     # partial session is lost) and resume its progress on the chip.
     pkill -f "scripts_scratch_train" 2>/dev/null
     sleep 5
-    timeout 9000 python scripts_scratch_train.py 40 25 r3
+    timeout -k 60 9000 python scripts_scratch_train.py 40 25 r3
     echo "train rc=$? at $(date +%H:%M:%S)"
-    exit 0
+  else
+    echo "watch $i: wedged at $(date +%H:%M:%S)"
   fi
-  echo "watch $i: wedged at $(date +%H:%M:%S)"
+  # idempotent (pgrep-guarded): also revives a trainer that crashed
+  # during a tunnel wedge, not just after a chip episode
+  restart_cpu_trainer
   sleep 1200
 done
+restart_cpu_trainer
