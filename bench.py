@@ -52,12 +52,14 @@ from sparksched_tpu.workload import make_workload_bank
 
 import os
 
-NUM_ENVS = 1024
+# lane count; overridable for off-chip smoke runs (the headline metric
+# is only comparable at the default 1024)
+NUM_ENVS = int(os.environ.get("BENCH_NUM_ENVS", 1024))
 # the tunneled v5e faults on >=1024-lane vmaps of the full step (kernel
 # fault at exactly the 8x128 tile boundary); process lanes in sub-batches
 # of 512 via lax.map inside one jit — same program, bounded vector width.
 # Overridable via env vars for on-chip tuning without edits.
-SUB_BATCH = int(os.environ.get("BENCH_SUB_BATCH", 512))
+SUB_BATCH = min(int(os.environ.get("BENCH_SUB_BATCH", 512)), NUM_ENVS)
 # the tunnel also kills device programs that run for tens of seconds, so
 # keep each timed program short and accumulate across calls
 BURST = int(os.environ.get("BENCH_BURST", 1))  # event sub-steps per group

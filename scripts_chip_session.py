@@ -91,6 +91,37 @@ def stage_flagship():
           flush=True)
 
 
+def stage_bench_1024():
+    """Headroom probe (PERF.md): retry the single-pass 1024-lane
+    sub-batch — the >=1024-lane kernel fault may have been specific to
+    since-replaced ops. Runs in a SUBPROCESS: in-process the stage-3
+    jit cache would silently reuse the 512-lane executable (SUB_BATCH
+    is baked in at trace time), and a kernel fault must not take the
+    session process down. Must be the last chip use of an episode — a
+    fault can still wedge the tunnel itself."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    from jax._src import xla_bridge
+
+    if xla_bridge._backends:
+        # one tunnel grant, no concurrent claims (PERF.md operational
+        # rules): the parent already holds a device client, so the
+        # subprocess could not acquire the chip. Run stage 7 standalone.
+        print("[bench-1024] parent process already holds a device "
+              "client; run stage 7 as its own invocation", flush=True)
+        return
+    env = os.environ | {"BENCH_SUB_BATCH": "1024"}
+    r = subprocess.run(
+        [sys.executable,
+         osp.join(osp.dirname(osp.abspath(__file__)), "bench.py")],
+        env=env, timeout=1800,
+    )
+    print(f"[bench-1024] subprocess rc={r.returncode}", flush=True)
+
+
 STAGES = {
     "1": ("sanity", stage_sanity),
     "2": ("burst sweep", stage_sweep),
@@ -98,6 +129,7 @@ STAGES = {
     "4": ("decima benches", stage_bench_decima),
     "5": ("flagship check", stage_flagship),
     "6": ("bulk probe", stage_bulk_probe),
+    "7": ("headline bench, sub-batch 1024", stage_bench_1024),
 }
 
 

@@ -43,6 +43,11 @@ print('ALIVE')
     sleep 5
     timeout -k 60 9000 python scripts_scratch_train.py 40 25 r3
     echo "train rc=$? at $(date +%H:%M:%S)"
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # fault-risk 1024-lane probe LAST in the chip episode: if it wedges
+    # the tunnel, nothing else in this window is lost
+    timeout -k 60 1900 python scripts_chip_session.py 7
+    echo "probe1024 rc=$? at $(date +%H:%M:%S)"
   else
     echo "watch $i: wedged at $(date +%H:%M:%S)"
   fi

@@ -32,9 +32,15 @@ import os
 # (10 executors / 50 jobs, reference examples.py:15-23) with a
 # proportionally larger decision cap
 _JOBS = int(os.environ.get("EVAL_JOBS", 20))
-ENV = dict(num_executors=10, max_jobs=_JOBS, moving_delay=2000.0,
+# EVAL_EXECS=50 reruns the table at the flagship scale of
+# config/decima_tpch.yaml (50 executors; reference decima_tpch.yaml)
+_EXECS = int(os.environ.get("EVAL_EXECS", 10))
+ENV = dict(num_executors=_EXECS, max_jobs=_JOBS, moving_delay=2000.0,
            warmup_delay=1000.0, job_arrival_rate=4.0e-5)
-STEPS = int(os.environ.get("EVAL_STEPS", 30 * _JOBS))
+# padded decision cap per episode: decisions scale with both jobs and
+# executors (every executor-availability event forces one); the default
+# reproduces 600 at the 10-exec/20-job training scale
+STEPS = int(os.environ.get("EVAL_STEPS", 3 * _JOBS * _EXECS))
 HELD_OUT_BASE = 10_000  # disjoint from training seeds (iteration-indexed)
 
 

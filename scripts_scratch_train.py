@@ -74,11 +74,14 @@ def make_cfg(tag: str, iters: int) -> dict:
     }
 
 
-def run(sessions: int, iters: int, tag: str = "r3") -> None:
-    cfg = make_cfg(tag, iters)
+def run_sessions(cfg: dict, out: str, sessions: int,
+                 label: str = "session") -> None:
+    """Shared bounded-session loop (also used by
+    scripts_flagship_train.py): train `cfg` repeatedly, resuming from
+    the artifacts dir's saved train state, writing the latest params to
+    `out` after each session."""
     art = cfg["trainer"]["artifacts_dir"]
     resume = osp.join(art, "train_state.msgpack")
-    out = f"/root/repo/models/decima/model_scratch_{tag}.msgpack"
     for s in range(sessions):
         t = make_trainer(cfg)
         state = t.train(
@@ -87,10 +90,18 @@ def run(sessions: int, iters: int, tag: str = "r3") -> None:
         with open(out, "wb") as fp:
             fp.write(serialization.to_bytes(jax.device_get(state.params)))
         print(
-            f"session {s + 1}/{sessions} done at iteration "
+            f"{label} {s + 1}/{sessions} done at iteration "
             f"{int(state.iteration)} -> {out}",
             flush=True,
         )
+
+
+def run(sessions: int, iters: int, tag: str = "r3") -> None:
+    run_sessions(
+        make_cfg(tag, iters),
+        f"/root/repo/models/decima/model_scratch_{tag}.msgpack",
+        sessions,
+    )
 
 
 if __name__ == "__main__":
