@@ -1,7 +1,9 @@
 """Generate the README-style Gantt comparison: fair vs (converted)
 pretrained Decima on the same seed (reference README.md:5-7 figure).
 
-Writes artifacts/gantt_fair.png and artifacts/gantt_decima.png.
+Writes artifacts/gantt_fair.png, artifacts/gantt_decima.png (the
+fine-tuned checkpoint) and artifacts/gantt_decima_scratch.png (the
+from-scratch, no-warm-start checkpoint).
 """
 
 import os
@@ -26,6 +28,10 @@ if __name__ == "__main__":
         # (EVAL_50.md: beats both fair and the converted reference ckpt)
         ("decima", "/root/repo/models/decima/model_ft.msgpack",
          "gantt_decima.png"),
+        # the from-scratch (no warm start) checkpoint — the policy this
+        # framework's own PPO produced (EVAL_50.md: +28.4% vs fair)
+        ("decima", "/root/repo/models/decima/model_tpu.msgpack",
+         "gantt_decima_scratch.png"),
     ]:
         sched = examples.make_scheduler(name, ckpt)
         avg = examples.run_episode(
