@@ -37,6 +37,12 @@ ENV_CFG = {
 }
 
 
+# shipped checkpoint, loaded when --state-dict is omitted: the
+# reference demo auto-loads its published weights the same way
+# (reference examples.py:69, models/decima/model.pt)
+DEFAULT_DECIMA_CKPT = "models/decima/model_tpu.msgpack"
+
+
 def make_scheduler(name: str, state_dict: str | None):
     n = ENV_CFG["num_executors"]
     if name == "fair":
@@ -46,6 +52,14 @@ def make_scheduler(name: str, state_dict: str | None):
     if name == "random":
         return RandomScheduler()
     if name == "decima":
+        if state_dict is None:
+            import os.path as osp
+
+            state_dict = osp.join(
+                osp.dirname(osp.abspath(__file__)), DEFAULT_DECIMA_CKPT
+            )
+            print(f"loading shipped checkpoint {DEFAULT_DECIMA_CKPT} "
+                  "(override with --state-dict)")
         return DecimaScheduler(
             num_executors=n,
             embed_dim=16,
@@ -101,7 +115,9 @@ if __name__ == "__main__":
     p.add_argument("--sched", default="fair",
                    choices=["fair", "fifo", "random", "decima"])
     p.add_argument("--state-dict", default=None,
-                   help="Decima weights (.pt torch or .msgpack)")
+                   help="Decima weights (.pt torch or .msgpack); "
+                        "default: the shipped "
+                        f"{DEFAULT_DECIMA_CKPT}")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-render", action="store_true")
     args = p.parse_args()

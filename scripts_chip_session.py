@@ -9,6 +9,12 @@ Stages (each guarded; a failure logs and moves on):
   4. Decima benches (inference + PPO throughput)
   5. flagship-scale compile/step check (config/decima_tpch.yaml shapes,
      one tiny iteration)
+  6. bulk probe (cascade-length calibration sweep)
+  7. headline bench at sub-batch 1024, in a subprocess. MUST be the
+     last chip use of an episode AND its own invocation (no earlier
+     in-process stages): a >=1024-lane kernel fault can wedge the
+     tunnel, and a parent that already holds the device client would
+     starve the subprocess of the chip grant.
 
 Usage: python scripts_chip_session.py [stage ...]   (default: 1 2 3 4)
 """
@@ -29,6 +35,24 @@ enable_compilation_cache()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
+
+
+# set by the stage runner whenever an in-process stage (1-6) runs: all
+# of them touch the device, and a held client means a subprocess (stage
+# 7) could not acquire the chip grant. The private-registry check is
+# only a best-effort fallback for direct function calls.
+_CLIENT_HELD = False
+
+
+def _client_held() -> bool:
+    if _CLIENT_HELD:
+        return True
+    try:  # pragma: no cover - depends on jax internals
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
 
 
 def stage_sanity():
@@ -104,9 +128,7 @@ def stage_bench_1024():
     import subprocess
     import sys
 
-    from jax._src import xla_bridge
-
-    if xla_bridge._backends:
+    if _client_held():
         # one tunnel grant, no concurrent claims (PERF.md operational
         # rules): the parent already holds a device client, so the
         # subprocess could not acquire the chip. Run stage 7 standalone.
@@ -145,3 +167,6 @@ if __name__ == "__main__":
             if p == "1":
                 print("chip unavailable; aborting session", flush=True)
                 break
+        finally:
+            if p != "7":
+                _CLIENT_HELD = True

@@ -101,8 +101,9 @@ PROVENANCE = {
     "decima (tpu-trained, no warm start)": (
         "from-scratch PPO in this framework "
         "(scripts_scratch_train.py round-3 recipe: entropy/lr anneal, "
-        "4x4 reference-parity lane layout; best-model checkpoint "
-        "through iteration 75, artifacts/decima_scratch_r3)"
+        "4x4 reference-parity lane layout; iteration-250 checkpoint — "
+        "the learning-curve peak, artifacts/decima_scratch_r3/"
+        "checkpoints/250)"
     ),
     "decima (tpu fine-tuned)": (
         "PPO fine-tune in this framework warm-started from the "
