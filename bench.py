@@ -74,6 +74,10 @@ BULK_EVENTS = int(_BULK_ENV) if _BULK_ENV is not None else None
 # bulk_events
 _FB_ENV = os.environ.get("BENCH_FULFILL_BULK")
 FULFILL_BULK = bool(int(_FB_ENV)) if _FB_ENV is not None else None
+# chained (relaunch + ready) pass pairs per micro-step
+# (flat_loop._bulk_cycle_chain); unset -> calibrated
+_BC_ENV = os.environ.get("BENCH_BULK_CYCLES")
+BULK_CYCLES = int(_BC_ENV) if _BC_ENV is not None else None
 MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
 assert NUM_ENVS % SUB_BATCH == 0, (
     f"BENCH_SUB_BATCH={SUB_BATCH} must divide {NUM_ENVS}"
@@ -85,9 +89,9 @@ NUM_CHUNKS = 4
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 
 
-@partial(jax.jit, static_argnums=(0, 4, 5))
+@partial(jax.jit, static_argnums=(0, 4, 5, 6))
 def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events,
-                fulfill_bulk):
+                fulfill_bulk, bulk_cycles=1):
     """MICRO_CHUNK flat micro-steps per lane; returns updated loop states
     and the total decision count across the batch."""
 
@@ -101,7 +105,8 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events,
             auto_reset=False, compute_levels=False, event_burst=BURST,
             event_bulk=bulk_events > 0,
             bulk_events=max(bulk_events, 1),
-            fulfill_bulk=fulfill_bulk, loop_state=ls,
+            fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
+            loop_state=ls,
         )
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
@@ -170,34 +175,51 @@ def main() -> None:
     # HBM-exceeding allocation — the tiled-layout cost of a program
     # differs across backends) is dropped from calibration instead of
     # killing the bench; at least one candidate must survive.
-    be_cands = [BULK_EVENTS] if BULK_EVENTS is not None else [8, 0]
-    fb_cands = [FULFILL_BULK] if FULFILL_BULK is not None else [True, False]
-    cands = [(be, fb) for be in be_cands for fb in fb_cands]
+    if (
+        BULK_EVENTS is not None
+        and FULFILL_BULK is not None
+        and BULK_CYCLES is not None
+    ):
+        cands = [(BULK_EVENTS, FULFILL_BULK, BULK_CYCLES)]
+    else:
+        be = BULK_EVENTS if BULK_EVENTS is not None else 8
+        fb = FULFILL_BULK if FULFILL_BULK is not None else True
+        bc = BULK_CYCLES if BULK_CYCLES is not None else 1
+        cands = [(be, fb, bc)]
+        if BULK_CYCLES is None and be > 0:
+            # bulk_cycles is a no-op with event bulking off
+            cands += [(be, fb, 2), (be, fb, 3)]
+        if FULFILL_BULK is None:
+            cands += [(be, False, bc)]
+        if BULK_EVENTS is None:
+            # no-bulk baseline, holding any explicitly pinned knobs
+            cands += [(0, fb, bc)]
+        cands = list(dict.fromkeys(cands))
     keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
     ok_cands = []
-    for i, (be, fb) in enumerate(cands):
+    for i, (be, fb, bc) in enumerate(cands):
         try:
             ls_try, n = bench_chunk(
-                params, bank, loop_states, keys, be, fb
+                params, bank, loop_states, keys, be, fb, bc
             )
             jax.block_until_ready(n)
         except Exception as err:
             print(
                 f"# bench: candidate bulk_events={be} "
-                f"fulfill_bulk={fb} skipped "
+                f"fulfill_bulk={fb} bulk_cycles={bc} skipped "
                 f"({type(err).__name__}: {str(err)[:200]})",
                 file=sys.stderr, flush=True,
             )
         else:
             loop_states = ls_try
-            ok_cands.append((be, fb))
+            ok_cands.append((be, fb, bc))
         keys = jax.random.split(jax.random.PRNGKey(90 + i), NUM_ENVS)
     if not ok_cands:
         raise RuntimeError("bench: every engine configuration failed")
     cands = ok_cands
     if len(cands) > 1:
         rates = {}
-        for i, (be, fb) in enumerate(cands):
+        for i, (be, fb, bc) in enumerate(cands):
             # re-seed finished lanes before each candidate so all
             # measure the same live-lane precondition
             loop_states = reset_done_lanes(
@@ -208,13 +230,18 @@ def main() -> None:
             kk = jax.random.split(jax.random.PRNGKey(70 + i), NUM_ENVS)
             tc = time.perf_counter()
             loop_states, n = bench_chunk(
-                params, bank, loop_states, kk, be, fb
+                params, bank, loop_states, kk, be, fb, bc
             )
             d1 = int(jax.block_until_ready(n))
-            rates[(be, fb)] = (d1 - d0) / (time.perf_counter() - tc)
-        bulk_events, fulfill_bulk = max(rates, key=rates.get)
+            rates[(be, fb, bc)] = (d1 - d0) / (time.perf_counter() - tc)
+            print(
+                f"# bench: candidate be={be} fb={int(fb)} bc={bc}: "
+                f"{rates[(be, fb, bc)]:.0f} dec/s",
+                file=sys.stderr, flush=True,
+            )
+        bulk_events, fulfill_bulk, bulk_cycles = max(rates, key=rates.get)
     else:
-        bulk_events, fulfill_bulk = cands[0]
+        bulk_events, fulfill_bulk, bulk_cycles = cands[0]
     # timed run starts from a freshly re-seeded lane population on both
     # the calibrated and the env-pinned paths
     loop_states = reset_done_lanes(
@@ -227,7 +254,8 @@ def main() -> None:
     for i in range(NUM_CHUNKS):
         keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
         loop_states, n = bench_chunk(
-            params, bank, loop_states, keys, bulk_events, fulfill_bulk
+            params, bank, loop_states, keys, bulk_events, fulfill_bulk,
+            bulk_cycles,
         )
         loop_states = reset_done_lanes(
             params, bank, loop_states,
@@ -258,8 +286,10 @@ def main() -> None:
                     "burst": BURST,
                     "bulk_events": int(bulk_events),
                     "fulfill_bulk": bool(fulfill_bulk),
+                    "bulk_cycles": int(bulk_cycles),
                     "calibrated": BULK_EVENTS is None
-                    or FULFILL_BULK is None,
+                    or FULFILL_BULK is None
+                    or BULK_CYCLES is None,
                     "prng_impl": str(jax.config.jax_default_prng_impl),
                     "backend": jax.default_backend(),
                 },
@@ -343,19 +373,23 @@ def _wait_for_backend() -> None:
     )
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
-    global BULK_EVENTS, FULFILL_BULK
+    global BULK_EVENTS, FULFILL_BULK, BULK_CYCLES
     if "BENCH_NUM_ENVS" not in os.environ:
         # keep the fallback bounded on a 1-core host; the metric name
         # carries the lane count so this cannot be mistaken for the
         # 1024-lane headline
         NUM_ENVS = 256
         SUB_BATCH = min(SUB_BATCH, NUM_ENVS)
-    if BULK_EVENTS is None and FULFILL_BULK is None:
-        # skip the 4-candidate calibration compile: minutes per
-        # candidate on one CPU core, and the driver's capture window
-        # is not guaranteed to wait. Pin the config the CPU probes
-        # measured best (PERF.md design responses 2/2b).
-        BULK_EVENTS, FULFILL_BULK = 8, True
+    # skip the multi-candidate calibration compile: minutes per
+    # candidate on one CPU core, and the driver's capture window is
+    # not guaranteed to wait. Pin any unset knob to the config the CPU
+    # probes measured best (PERF.md design responses 2/2b).
+    if BULK_EVENTS is None:
+        BULK_EVENTS = 8
+    if FULFILL_BULK is None:
+        FULFILL_BULK = True
+    if BULK_CYCLES is None:
+        BULK_CYCLES = 2
 
 
 if __name__ == "__main__":

@@ -135,7 +135,15 @@ def stage_bench_1024():
         print("[bench-1024] parent process already holds a device "
               "client; run stage 7 as its own invocation", flush=True)
         return
-    env = os.environ | {"BENCH_SUB_BATCH": "1024"}
+    # no CPU fallback and a short wait: this stage exists ONLY to retry
+    # the 1024-lane sub-batch on the real chip — bench.py's default
+    # fallback would silently turn a wedged tunnel into a meaningless
+    # 256-lane CPU run that reports success
+    env = os.environ | {
+        "BENCH_SUB_BATCH": "1024",
+        "BENCH_CPU_FALLBACK": "0",
+        "BENCH_WAIT_SECS": "120",
+    }
     r = subprocess.run(
         [sys.executable,
          osp.join(osp.dirname(osp.abspath(__file__)), "bench.py")],

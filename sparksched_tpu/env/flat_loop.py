@@ -114,6 +114,56 @@ def _pop_event(params: EnvParams, st: EnvState, enabled):
     return st, rk, rj, rs, arg, quirk
 
 
+def _bulk_cycle_chain(
+    params: EnvParams,
+    bank: WorkloadBank,
+    env: EnvState,
+    is_event: jnp.ndarray,
+    bulk_events: int,
+    bulk_cycles: int,
+):
+    """`bulk_cycles` chained (relaunch cascade + arrival burst) pass
+    pairs. The first pair runs whenever the lane is in EVENT mode (the
+    round-3 behavior); each further pair runs only while the sequential
+    between-event tail would be a no-op — `num_committable() == 0`
+    (round-ready flip and move_and_clear are gated on committable > 0)
+    and the wall clock inside the episode limit (the freeze point) — so
+    chaining is exactly the next micro-step's bulk phase minus its
+    provably-no-op tail. Returns (env, events_consumed)."""
+    nb = _i32(0)
+    for i in range(bulk_cycles):
+        on = is_event if i == 0 else (
+            is_event
+            & (env.num_committable() == 0)
+            & (env.wall_time < env.time_limit)
+        )
+        env, nbi1 = _bulk_relaunch(
+            params, bank, env, on,
+            stop_at_limit=True, max_events=bulk_events,
+        )
+        # chain the arrival-burst pass; never past an episode-limit
+        # crossing the cascade just committed (the freeze point)
+        env, nbi2 = _bulk_ready(
+            params, bank, env,
+            on & (env.wall_time < env.time_limit),
+            stop_at_limit=True,
+        )
+        nb = nb + nbi1 + nbi2
+    return env, nb
+
+
+def _fused_pop_gate(env: EnvState, nb: jnp.ndarray) -> jnp.ndarray:
+    """May this micro-step still pop the run-cutting event after its
+    bulk passes consumed `nb` events? Always when nothing was bulked
+    (the classic single-pop path — the previous micro-step's tail ran
+    for real); after a bulk only when the skipped between-event tail is
+    provably a no-op (see `_bulk_cycle_chain`)."""
+    return (nb == 0) | (
+        (env.num_committable() == 0)
+        & (env.wall_time < env.time_limit)
+    )
+
+
 def _clear_round(st: EnvState) -> EnvState:
     return st.replace(
         source_valid=jnp.bool_(False),
@@ -136,13 +186,24 @@ def micro_step(
     event_bulk: bool = True,
     bulk_events: int = 8,
     fulfill_bulk: bool = False,
+    bulk_cycles: int = 1,
 ) -> LoopState:
     """One unit of work for one lane (vmap over lanes). With
     `event_bulk`, an EVENT micro-step consumes a whole run of relaunch
     events via `core._bulk_relaunch` (hoisted above the mode switch —
     it samples task durations, and bank accesses must stay out of
-    lane-dependent branches; see core's structural note) and only falls
-    back to the single-event pop when the run is empty.
+    lane-dependent branches; see core's structural note), chains the
+    arrival-burst pass, and then — new in round 4 — still pops the
+    run-cutting event in the SAME micro-step ("fused pop") whenever the
+    sequential engine's between-event tail is provably a no-op:
+    `num_committable() == 0` (the tail's round-ready flip and
+    move_and_clear are both gated on committable > 0, and the bulk
+    passes stop BEFORE any point where they could raise it — a
+    source-joining arrival ends `_bulk_ready`'s prefix) and the wall
+    clock is inside the episode limit (the freeze point). `bulk_cycles`
+    extra (relaunch + ready) pass pairs run first under the same gate,
+    consuming alternating run/burst patterns that previously cost one
+    micro-step per kind switch.
 
     With `fulfill_bulk`, a DECIDE micro-step that finishes a commitment
     round consumes the fulfillment phase's simple prefix in one
@@ -156,19 +217,10 @@ def micro_step(
     k_pol, k_reset = jax.random.split(rng)
     ls0 = ls  # pre-bulk state: the freeze path must restore exactly this
     if event_bulk:
-        env_b, nb1 = _bulk_relaunch(
-            params, bank, ls.env, ls.mode == M_EVENT,
-            stop_at_limit=True, max_events=bulk_events,
+        env_b, nb = _bulk_cycle_chain(
+            params, bank, ls.env, ls.mode == M_EVENT, bulk_events,
+            bulk_cycles,
         )
-        # chain the arrival-burst pass; never past an episode-limit
-        # crossing the cascade just committed (the freeze point)
-        env_b, nb2 = _bulk_ready(
-            params, bank, env_b,
-            (ls.mode == M_EVENT)
-            & (env_b.wall_time < env_b.time_limit),
-            stop_at_limit=True,
-        )
-        nb = nb1 + nb2
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
     else:
         nb = _i32(0)
@@ -288,10 +340,14 @@ def micro_step(
         return ls.replace(env=st, mode=mode, fulfill_k=k + 1), rk, rj, rs, \
             e, quirk
 
-    # ---- EVENT: one event pop + handling (core._resume_simulation body);
-    # no-op when the bulk pass above already consumed this step's events
+    # ---- EVENT: one event pop + handling (core._resume_simulation
+    # body). Fused pop: even after the bulk passes consumed events, the
+    # run-cutting event they stopped at is popped in the same micro-step
+    # when the skipped between-event tail is provably a no-op
     def event(ls: LoopState):
-        st, rk, rj, rs, arg, quirk = _pop_event(params, ls.env, nb == 0)
+        st, rk, rj, rs, arg, quirk = _pop_event(
+            params, ls.env, _fused_pop_gate(ls.env, nb)
+        )
         return ls.replace(env=st), rk, rj, rs, arg, quirk
 
     ls2, rk, rj, rs, e, quirk = lax.switch(
@@ -433,6 +489,7 @@ def event_micro_step(
     auto_reset: bool = True,
     event_bulk: bool = True,
     bulk_events: int = 8,
+    bulk_cycles: int = 1,
 ) -> LoopState:
     """One EVENT-only micro-step: lanes in M_EVENT mode pop + handle one
     event (with the full shared tail); other lanes no-op.
@@ -450,18 +507,11 @@ def event_micro_step(
 
     ls0 = ls.replace(mode=_i32(M_EVENT))  # pre-bulk state for the tail
     if event_bulk:
-        env_b, nb1 = _bulk_relaunch(
-            params, bank, ls.env, is_event,
-            stop_at_limit=True, max_events=bulk_events,
+        env_b, nb = _bulk_cycle_chain(
+            params, bank, ls.env, is_event, bulk_events, bulk_cycles,
         )
-        env_b, nb2 = _bulk_ready(
-            params, bank, env_b,
-            is_event & (env_b.wall_time < env_b.time_limit),
-            stop_at_limit=True,
-        )
-        nb = nb1 + nb2
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
-        pop_on = is_event & (nb == 0)
+        pop_on = is_event & _fused_pop_gate(env_b, nb)
     else:
         pop_on = is_event
     st, rk, rj, rs, arg, quirk = _pop_event(params, ls.env, pop_on)
@@ -489,6 +539,7 @@ def run_flat(
     event_bulk: bool = True,
     bulk_events: int = 8,
     fulfill_bulk: bool = False,
+    bulk_cycles: int = 1,
     loop_state: LoopState | None = None,
 ) -> LoopState:
     """Scan `num_groups` micro-step groups for one lane (vmap over
@@ -505,12 +556,13 @@ def run_flat(
         ls = micro_step(
             params, bank, policy_fn, ls, sub, auto_reset,
             compute_levels, event_bulk, bulk_events, fulfill_bulk,
+            bulk_cycles,
         )
         for _ in range(event_burst - 1):
             k, sub = jax.random.split(k)
             ls = event_micro_step(
                 params, bank, ls, sub, auto_reset, event_bulk,
-                bulk_events,
+                bulk_events, bulk_cycles,
             )
         return (ls, k), None
 

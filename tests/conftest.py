@@ -17,3 +17,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 assert jax.default_backend() == "cpu", jax.default_backend()
+
+# Persist XLA compilations across suite runs: on this 1-core box most of
+# the suite's wall time is compiles of the same programs every run. The
+# cache entries are keyed by backend/topology, so the 8-device-CPU test
+# programs coexist with the chip's in the same .jax_cache directory.
+from sparksched_tpu.config import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()

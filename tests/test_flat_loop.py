@@ -43,15 +43,31 @@ def test_flat_loop_matches_step_loop(spec_fn, num_exec, burst, fulfill_bulk):
     spec = spec_fn()
     params, bank, state0 = make_tpu_env_state(spec, num_exec)
 
-    # step loop
-    state = state0
-    decisions = 0
-    while not bool(state.terminated) and decisions < 4000:
-        obs = observe(params, state)
-        si, ne = round_robin_policy(obs, num_exec, True)
-        state, _, _, _ = core.step(params, bank, state, si, ne)
-        decisions += 1
+    # step loop, advanced in jitted chunks with a done-freeze (the
+    # per-call python loop made this one of the slowest fast-tier tests)
+    @jax.jit
+    def step_chunk(state, decisions):
+        def body(carry, _):
+            state, decisions = carry
+            done = state.terminated
+            obs = observe(params, state)
+            si, ne = round_robin_policy(obs, num_exec, True)
+            state2, _, _, _ = core.step(params, bank, state, si, ne)
+            state = jax.tree_util.tree_map(
+                lambda frozen, stepped: jnp.where(done, frozen, stepped),
+                state, state2,
+            )
+            return (state, decisions + ~done), None
+
+        return jax.lax.scan(body, (state, decisions), None, length=100)[0]
+
+    state, decisions = state0, jnp.int32(0)
+    for _ in range(40):
+        state, decisions = step_chunk(state, decisions)
+        if bool(state.terminated):
+            break
     assert bool(state.terminated)
+    decisions = int(decisions)
 
     # flat loop (frozen lanes at completion)
     def pol(rng, obs):
@@ -135,15 +151,27 @@ def test_bulk_stop_at_limit_matches_single_event_flat_loop():
     for limit in (9000.0, 12503.0, 12504.0, 30000.0, 61111.0):
         st = s0.replace(time_limit=jnp.float32(limit))
         outs = []
-        for bulk in (True, False):
+        # bulk_cycles=3 stresses the chained-pass freeze gate (each
+        # extra pass must refuse to run once the limit was crossed)
+        for bulk, bc in ((True, 1), (True, 3), (False, 1)):
             ls = jax.jit(
-                lambda s, r, b=bulk: run_flat(
+                lambda s, r, b=bulk, c=bc: run_flat(
                     params, bank, pol, r, 4000, s,
-                    auto_reset=False, event_bulk=b,
+                    auto_reset=False, event_bulk=b, bulk_cycles=c,
                 )
             )(st, jax.random.PRNGKey(0))
             outs.append(ls)
-        a, b = outs
+        a, b = outs[0], outs[2]
+        c3 = outs[1]
+        la3 = jax.tree_util.tree_leaves_with_path(c3)
+        for (pa, x), y in zip(la3, jax.tree_util.tree_leaves(b)):
+            name = jax.tree_util.keystr(pa)
+            if name in (".env.rng", ".bulked", ".mode"):
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y),
+                err_msg=f"limit {limit} cycles=3, field {name}",
+            )
         assert int(a.episodes) == 1, f"limit {limit}: episode did not end"
         assert int(a.decisions) == int(b.decisions), f"limit {limit}"
         la = jax.tree_util.tree_leaves_with_path(a)
@@ -280,16 +308,41 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(
         max_stages=bank.max_stages, max_levels=bank.max_stages
     )
 
-    for seed in (0, 3):
-        sa = sb = core.reset(params, bank, jax.random.PRNGKey(seed))
-        term = False
-        for t in range(1500):
+    # both engines advance inside ONE jitted chunked scan (the policy is
+    # computed once per step from the bulk arm's state and applied to
+    # both), with full-tree equality checked at every chunk boundary —
+    # the same invariant as a per-step comparison, at a fraction of the
+    # dispatch/host-transfer cost that made this the slowest test in the
+    # fast tier
+    CHUNK = 50
+
+    @jax.jit
+    def step_pair_chunk(sa, sb, done):
+        def body(carry, _):
+            sa, sb, done = carry
             obs = observe(params, sa)
             si, ne = round_robin_policy(obs, params.num_executors, True)
-            sa, _, term, _ = core.step(params, bank, sa, si, ne,
-                                       bulk=True)
-            sb, _, _, _ = core.step(params, bank, sb, si, ne,
-                                    bulk=False)
+            sa2, _, term, _ = core.step(params, bank, sa, si, ne,
+                                        bulk=True)
+            sb2, _, _, _ = core.step(params, bank, sb, si, ne,
+                                     bulk=False)
+            sa, sb = jax.tree_util.tree_map(
+                lambda frozen, stepped: jnp.where(done, frozen, stepped),
+                (sa, sb), (sa2, sb2),
+            )
+            done = done | term
+            return (sa, sb, done), None
+
+        (sa, sb, done), _ = jax.lax.scan(
+            body, (sa, sb, done), None, length=CHUNK
+        )
+        return sa, sb, done
+
+    for seed in (0, 3):
+        sa = sb = core.reset(params, bank, jax.random.PRNGKey(seed))
+        done = jnp.bool_(False)
+        for chunk in range(1500 // CHUNK):
+            sa, sb, done = step_pair_chunk(sa, sb, done)
             la = jax.tree_util.tree_leaves_with_path(sa)
             lb = jax.tree_util.tree_leaves(sb)
             for (pa, a), b in zip(la, lb):
@@ -298,11 +351,11 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(
                     continue
                 np.testing.assert_array_equal(
                     np.asarray(a), np.asarray(b),
-                    err_msg=f"seed {seed} step {t}, field {name}",
+                    err_msg=f"seed {seed} chunk {chunk}, field {name}",
                 )
-            if bool(term):
+            if bool(done):
                 break
-        assert bool(term), f"seed {seed}: episode did not finish"
+        assert bool(done), f"seed {seed}: episode did not finish"
 
         # the flat micro-step engine (bench path) must land on the same
         # terminal state as the per-decision loop — with single-fulfill
@@ -313,23 +366,29 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(
             si, ne = round_robin_policy(obs, params.num_executors, True)
             return si, ne, {}
 
-        for fb in (False, True):
+        # bulk_cycles > 1 chains extra (relaunch + ready) pairs per
+        # micro-step and exercises the round-4 fused pop (the default
+        # engine pops the run-cutting event in the same micro-step)
+        for fb, bc in ((False, 1), (True, 1), (True, 2), (True, 3)):
             ls = jax.jit(
-                lambda s, r, fb=fb: run_flat(
+                lambda s, r, fb=fb, bc=bc: run_flat(
                     params, bank, pol, r, 6000, s, auto_reset=False,
-                    fulfill_bulk=fb,
+                    fulfill_bulk=fb, bulk_cycles=bc,
                 )
             )(core.reset(params, bank, jax.random.PRNGKey(seed)),
               jax.random.PRNGKey(0))
             assert int(ls.episodes) == 1, (
-                f"seed {seed} fb={fb}: flat episode open"
+                f"seed {seed} fb={fb} bc={bc}: flat episode open"
             )
             np.testing.assert_allclose(
                 float(ls.env.wall_time), float(sa.wall_time), rtol=1e-6,
-                err_msg=f"seed {seed} fb={fb}: flat wall_time",
+                err_msg=f"seed {seed} fb={fb} bc={bc}: flat wall_time",
             )
             np.testing.assert_allclose(
                 np.asarray(ls.env.job_t_completed),
                 np.asarray(sa.job_t_completed), rtol=1e-6,
-                err_msg=f"seed {seed} fb={fb}: flat job completion times",
+                err_msg=(
+                    f"seed {seed} fb={fb} bc={bc}: flat job "
+                    "completion times"
+                ),
             )
