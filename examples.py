@@ -75,7 +75,7 @@ def make_scheduler(name: str, state_dict: str | None):
 
 
 def run_episode(scheduler, seed: int = 0, render: bool = True,
-                max_steps: int = 20000) -> float:
+                max_steps: int = 20000, live: bool = False) -> float:
     params = EnvParams(**ENV_CFG)
     bank = make_workload_bank(params.num_executors, params.max_stages)
     if bank.max_stages != params.max_stages:
@@ -83,7 +83,10 @@ def run_episode(scheduler, seed: int = 0, render: bool = True,
             max_stages=bank.max_stages, max_levels=bank.max_stages
         )
     state = core.reset(params, bank, jax.random.PRNGKey(seed))
-    renderer = GanttRenderer(params.num_executors) if render else None
+    renderer = GanttRenderer(
+        params.num_executors,
+        live_path="screenshot.png" if live else None,
+    ) if render else None
     rng = jax.random.PRNGKey(seed + 1)
     policy = jax.jit(scheduler.policy)
 
@@ -120,9 +123,13 @@ if __name__ == "__main__":
                         f"{DEFAULT_DECIMA_CKPT}")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-render", action="store_true")
+    p.add_argument("--live", action="store_true",
+                   help="refresh screenshot.png during the episode "
+                        "(reference render_frame analog)")
     args = p.parse_args()
     run_episode(
         make_scheduler(args.sched, args.state_dict),
         seed=args.seed,
         render=not args.no_render,
+        live=args.live,
     )

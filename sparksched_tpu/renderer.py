@@ -20,19 +20,48 @@ from .env.state import EnvState
 
 
 class GanttRenderer:
-    def __init__(self, num_executors: int) -> None:
+    """Post-hoc and live Gantt rendering.
+
+    `live_path` + `live_every` approximate the reference's real-time
+    render mode (reference components/renderer.py:45-81 `render_frame`,
+    one pygame frame per decision): every `live_every` recorded
+    decisions the chart is redrawn to `live_path`, so an episode in
+    progress can be watched by any image viewer that follows the file.
+    Headless boxes have no display server, so a refreshed file is the
+    render target — the reference equally falls back to a saved
+    `screenshot.png` artifact on close."""
+
+    def __init__(self, num_executors: int, live_path: str | None = None,
+                 live_every: int = 50) -> None:
         self.num_executors = num_executors
         self.times: list[float] = []
         self.exec_job: list[np.ndarray] = []
         self.exec_busy: list[np.ndarray] = []
         self.final_state: EnvState | None = None
+        self.live_path = live_path
+        self.live_every = max(int(live_every), 1)
+        self._live_last = 0.0
 
     def record(self, state: EnvState) -> None:
-        """Snapshot executor assignment after an env step."""
+        """Snapshot executor assignment after an env step; in live mode,
+        refresh the on-disk frame every `live_every` snapshots — rate-
+        limited to one redraw per second of wall clock, since each
+        refresh redraws the full history (O(snapshots)) and an unlimited
+        refresh cadence would make long episodes rendering-bound."""
         self.times.append(float(state.wall_time))
         self.exec_job.append(np.asarray(state.exec_job))
         self.exec_busy.append(np.asarray(state.exec_executing))
         self.final_state = state
+        if (
+            self.live_path is not None
+            and len(self.times) % self.live_every == 0
+        ):
+            import time as _time
+
+            now = _time.monotonic()
+            if now - self._live_last >= 1.0:
+                self._live_last = now
+                self.render(self.live_path)
 
     def _segments(self):
         """Merge consecutive snapshots into (executor, job, t0, t1) bars."""

@@ -14,6 +14,24 @@ from .reference_fixtures import (
 )
 
 
+def _neq_ignoring_rng(sa, sb):
+    """In-graph: any state field (rng excluded) differs between the
+    engines. Used by the chunked equivalence scans to record the exact
+    first-divergence step without per-step host transfers."""
+    import jax
+    import jax.numpy as jnp
+
+    neq = jnp.bool_(False)
+    for (pa, x), y in zip(
+        jax.tree_util.tree_leaves_with_path(sa),
+        jax.tree_util.tree_leaves(sb),
+    ):
+        if jax.tree_util.keystr(pa) == ".rng":
+            continue
+        neq = neq | jnp.any(x != y)
+    return neq
+
+
 # fast tier keeps the diamond fixture at burst 1 under BOTH fulfillment
 # modes (False is the library default every non-bench caller uses; True
 # is one of bench.py's self-calibration candidates); the multi-job and
@@ -106,14 +124,48 @@ def test_bulk_relaunch_matches_sequential_event_loop():
     from sparksched_tpu.env.observe import observe
     from sparksched_tpu.schedulers import round_robin_policy
 
+    import jax.numpy as jnp
+
     for spec_fn, n_exec in ((spec_diamond, 4), (lambda: spec_multi_job(4, 11), 5)):
         params, bank, s0 = make_tpu_env_state(spec_fn(), n_exec)
+
+        # both engines advance inside one jitted chunked scan; a
+        # per-step in-scan divergence tracker preserves the old host
+        # loop's step-exact localization while the full tree compare
+        # runs only at chunk boundaries
+        @jax.jit
+        def step_pair_chunk(sa, sb, done, div, base):
+            def body(carry, i):
+                sa, sb, done, div = carry
+                obs = observe(params, sa)
+                si, ne = round_robin_policy(obs, n_exec, True)
+                sa2, _, term, _ = core.step(params, bank, sa, si, ne,
+                                            bulk=True)
+                sb2, _, _, _ = core.step(params, bank, sb, si, ne,
+                                         bulk=False)
+                sa, sb = jax.tree_util.tree_map(
+                    lambda frozen, stepped: jnp.where(
+                        done, frozen, stepped
+                    ),
+                    (sa, sb), (sa2, sb2),
+                )
+                div = jnp.where(
+                    (div < 0) & _neq_ignoring_rng(sa, sb), base + i, div
+                )
+                done = done | term
+                return (sa, sb, done, div), None
+
+            return jax.lax.scan(
+                body, (sa, sb, done, div), jnp.arange(100)
+            )[0]
+
         sa = sb = s0
-        for t in range(4000):
-            obs = observe(params, sa)
-            si, ne = round_robin_policy(obs, n_exec, True)
-            sa, _, term, _ = core.step(params, bank, sa, si, ne, bulk=True)
-            sb, _, _, _ = core.step(params, bank, sb, si, ne, bulk=False)
+        done = jnp.bool_(False)
+        div = jnp.int32(-1)
+        for chunk in range(40):
+            sa, sb, done, div = step_pair_chunk(
+                sa, sb, done, div, jnp.int32(chunk * 100)
+            )
             la = jax.tree_util.tree_leaves_with_path(sa)
             lb = jax.tree_util.tree_leaves(sb)
             for (pa, a), b in zip(la, lb):
@@ -122,11 +174,17 @@ def test_bulk_relaunch_matches_sequential_event_loop():
                     continue
                 np.testing.assert_array_equal(
                     np.asarray(a), np.asarray(b),
-                    err_msg=f"step {t}, field {name}",
+                    err_msg=(
+                        f"chunk {chunk}, field {name}, first "
+                        f"divergence at step {int(div)}"
+                    ),
                 )
-            if bool(term):
+            assert int(div) < 0, (
+                f"transient divergence at step {int(div)}"
+            )
+            if bool(done):
                 break
-        assert bool(term)
+        assert bool(done)
 
 
 @pytest.mark.slow
@@ -317,9 +375,9 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(
     CHUNK = 50
 
     @jax.jit
-    def step_pair_chunk(sa, sb, done):
-        def body(carry, _):
-            sa, sb, done = carry
+    def step_pair_chunk(sa, sb, done, div, base):
+        def body(carry, i):
+            sa, sb, done, div = carry
             obs = observe(params, sa)
             si, ne = round_robin_policy(obs, params.num_executors, True)
             sa2, _, term, _ = core.step(params, bank, sa, si, ne,
@@ -330,19 +388,25 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(
                 lambda frozen, stepped: jnp.where(done, frozen, stepped),
                 (sa, sb), (sa2, sb2),
             )
+            div = jnp.where(
+                (div < 0) & _neq_ignoring_rng(sa, sb), base + i, div
+            )
             done = done | term
-            return (sa, sb, done), None
+            return (sa, sb, done, div), None
 
-        (sa, sb, done), _ = jax.lax.scan(
-            body, (sa, sb, done), None, length=CHUNK
+        (sa, sb, done, div), _ = jax.lax.scan(
+            body, (sa, sb, done, div), jnp.arange(CHUNK)
         )
-        return sa, sb, done
+        return sa, sb, done, div
 
     for seed in (0, 3):
         sa = sb = core.reset(params, bank, jax.random.PRNGKey(seed))
         done = jnp.bool_(False)
+        div = jnp.int32(-1)
         for chunk in range(1500 // CHUNK):
-            sa, sb, done = step_pair_chunk(sa, sb, done)
+            sa, sb, done, div = step_pair_chunk(
+                sa, sb, done, div, jnp.int32(chunk * CHUNK)
+            )
             la = jax.tree_util.tree_leaves_with_path(sa)
             lb = jax.tree_util.tree_leaves(sb)
             for (pa, a), b in zip(la, lb):
@@ -351,8 +415,14 @@ def test_bulk_paths_match_sequential_on_synthetic_bank(
                     continue
                 np.testing.assert_array_equal(
                     np.asarray(a), np.asarray(b),
-                    err_msg=f"seed {seed} chunk {chunk}, field {name}",
+                    err_msg=(
+                        f"seed {seed} chunk {chunk}, field {name}, "
+                        f"first divergence at step {int(div)}"
+                    ),
                 )
+            assert int(div) < 0, (
+                f"seed {seed}: transient divergence at step {int(div)}"
+            )
             if bool(done):
                 break
         assert bool(done), f"seed {seed}: episode did not finish"

@@ -19,6 +19,30 @@ def test_examples_fair_episode(tmp_path, monkeypatch):
     assert osp.isfile(osp.join(tmp_path, "screenshot.png"))
 
 
+def test_renderer_live_mode_refreshes_frame(tmp_path):
+    """Live render mode (reference render_frame analog): the on-disk
+    frame must exist after `live_every` recorded decisions, well before
+    the episode's final render call."""
+    import jax
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.renderer import GanttRenderer
+    from sparksched_tpu.workload import make_workload_bank
+
+    params = EnvParams(num_executors=3, max_jobs=2)
+    bank = make_workload_bank(params.num_executors)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    state = core.reset(params, bank, jax.random.PRNGKey(0))
+    frame = osp.join(tmp_path, "live.png")
+    r = GanttRenderer(params.num_executors, live_path=frame, live_every=3)
+    for _ in range(3):
+        r.record(state)
+    assert osp.isfile(frame)
+
+
 def test_config_loader(tmp_path):
     import yaml
 
