@@ -1427,8 +1427,15 @@ def _resume_simulation(
     """Pop events until there are new scheduling decisions to make or the
     queue drains (reference :320-343). `active` masks the whole loop.
     With `bulk`, each iteration first consumes a whole run of relaunch
-    events via `_bulk_relaunch` and only falls back to the single-event
-    path when the next event is something else."""
+    events via `_bulk_relaunch` plus the arrival-burst prefix, and then
+    — fused pop, mirroring the flat engine — still pops the run-cutting
+    event in the SAME iteration whenever the skipped between-event tail
+    is provably a no-op: `num_committable() == 0` (the tail's
+    round-ready flip and move_and_clear are both gated on
+    committable > 0, and `_bulk_ready` ends its prefix at any arrival
+    that could raise it). Under vmap the while loop costs the batch-max
+    iteration count, so consuming bulk + cutter per iteration cuts the
+    straggler tax for every lane."""
 
     def cond(st: EnvState) -> jnp.ndarray:
         has, _, _, _ = _next_event(params, st)
@@ -1441,10 +1448,12 @@ def _resume_simulation(
                 max_events=bulk_events,
             )
             st, nb2 = _bulk_ready(params, bank, st, jnp.bool_(True))
-            single = (nb1 + nb2) == 0
+            single = ((nb1 + nb2) == 0) | (st.num_committable() == 0)
         else:
             single = jnp.bool_(True)
-        _, t, kind, arg = _next_event(params, st)
+        # `has` must re-gate the fused pop: the bulk passes above may
+        # have consumed the queue's last events (e.g. a parked arrival)
+        has, t, kind, arg = _next_event(params, st)
 
         def pop(st: EnvState):
             st = st.replace(wall_time=t)
@@ -1464,7 +1473,7 @@ def _resume_simulation(
         def nopop(st: EnvState):
             return st, _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(-1)
 
-        st, rk, rj, rs, quirk_src = lax.cond(single, pop, nopop, st)
+        st, rk, rj, rs, quirk_src = lax.cond(single & has, pop, nopop, st)
         ak, tj, ts = _resolve_action(params, st, rk, arg, rj, rs, quirk_src)
         st = _apply_action(params, bank, st, ak, arg, tj, ts)
         committable = st.num_committable()
