@@ -43,14 +43,18 @@ print('ALIVE')
     # scan glue need the single core
     pkill -f "scripts_plateau_train" 2>/dev/null
     sleep 2
-    timeout -k 60 4500 python scripts_chip_session.py 1 3 4
+    timeout -k 60 3600 python scripts_chip_session.py 1 3
     echo "session rc=$? at $(date +%H:%M:%S)"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
-    # leftover chip time: flagship-scale training in short resumable
-    # sessions (state saved every session; a tunnel wedge mid-session
-    # loses at most iters_per_session iterations)
-    timeout -k 60 9000 python scripts_flagship_train.py 20 2
+    # flagship-scale training BEFORE the decima benches: VERDICT ranks
+    # it higher, and round 3's tunnel window died inside a decima-bench
+    # compile. Short resumable sessions (state saved every session; a
+    # wedge mid-session loses at most iters_per_session iterations).
+    timeout -k 60 7200 python scripts_flagship_train.py 20 2
     echo "flagship rc=$? at $(date +%H:%M:%S)"
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    timeout -k 60 2700 python scripts_chip_session.py 4
+    echo "decima-bench rc=$? at $(date +%H:%M:%S)"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # fault-risk 1024-lane probe LAST in the chip episode: if it wedges
     # the tunnel, nothing else in this window is lost
