@@ -99,11 +99,11 @@ CKPTS = {
 # checkpoints it actually evaluated
 PROVENANCE = {
     "decima (tpu-trained, no warm start)": (
-        "from-scratch PPO in this framework "
-        "(scripts_scratch_train.py round-3 recipe: entropy/lr anneal, "
-        "4x4 reference-parity lane layout; iteration-250 checkpoint — "
-        "the learning-curve peak, artifacts/decima_scratch_r3/"
-        "checkpoints/250)"
+        "from-scratch PPO in this framework: round-3 recipe through "
+        "iteration 250 (scripts_scratch_train.py), then the round-4 "
+        "plateau continuation with corrected late-training schedules "
+        "(scripts_plateau_train.py); best-model checkpoint at curve "
+        "iteration ~400, artifacts/decima_plateau/checkpoints/150"
     ),
     "decima (tpu fine-tuned)": (
         "PPO fine-tune in this framework warm-started from the "
