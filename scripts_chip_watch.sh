@@ -22,10 +22,12 @@ cd /root/repo
 rm -f /tmp/stop_chip_watch  # consume any stale stop request at launch
 
 restart_cpu_trainer() {
-  if ! pgrep -f "scripts_plateau_train" > /dev/null; then
-    JAX_PLATFORMS=cpu nohup nice -n 10 python scripts_plateau_train.py \
-      10 25 >> /tmp/plateau_train.log 2>&1 &
-    echo "cpu plateau trainer restarted (pid $!) at $(date +%H:%M:%S)"
+  # plateau run complete (curve 250->500, EVAL.md); CPU now continues
+  # the fine-tuned artifact under the corrected schedules
+  if ! pgrep -f "scripts_ft_continue" > /dev/null; then
+    JAX_PLATFORMS=cpu nohup nice -n 10 python scripts_ft_continue.py \
+      4 25 >> /tmp/ft_continue.log 2>&1 &
+    echo "cpu ft-continuation trainer restarted (pid $!) at $(date +%H:%M:%S)"
   fi
 }
 
@@ -41,7 +43,7 @@ print('ALIVE')
     echo "chip alive at $(date +%H:%M:%S); running session"
     # stop the CPU trainer for the chip window: compiles and host-side
     # scan glue need the single core
-    pkill -f "scripts_plateau_train" 2>/dev/null
+    pkill -f "scripts_plateau_train\|scripts_ft_continue" 2>/dev/null
     sleep 2
     timeout -k 60 3600 python scripts_chip_session.py 1 3
     echo "session rc=$? at $(date +%H:%M:%S)"
