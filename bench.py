@@ -87,6 +87,24 @@ assert 1 <= BURST <= MICRO_CHUNK and MICRO_CHUNK % BURST == 0, (
 )
 NUM_CHUNKS = 4
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
+# extra bulk_cycles values tried when BENCH_BULK_CYCLES is unset (the
+# baseline candidate always runs bc=1); the CPU fallback shrinks this —
+# every candidate costs a warmup + calibration chunk at full lane
+# count, and bc=3 has never won a CPU probe (PERF.md round-4 table)
+_BC_CANDS = (2, 3)
+# set by _wait_for_backend when the accelerator never answered and the
+# run proceeded on host CPU. main() suffixes the metric name whenever
+# the executing backend is CPU — "_cpufallback" for the unattended
+# fallback, "_cpu" for an explicit JAX_PLATFORMS=cpu run — so the
+# headline TPU metric name can never carry a CPU value (round-4
+# advisor), even when a caller pins BENCH_NUM_ENVS=1024 explicitly
+CPU_FALLBACK = False
+
+
+def _metric_suffix() -> str:
+    if CPU_FALLBACK:
+        return "_cpufallback"
+    return "_cpu" if jax.default_backend() == "cpu" else ""
 
 
 @partial(jax.jit, static_argnums=(0, 4, 5, 6))
@@ -188,7 +206,7 @@ def main() -> None:
         cands = [(be, fb, bc)]
         if BULK_CYCLES is None and be > 0:
             # bulk_cycles is a no-op with event bulking off
-            cands += [(be, fb, 2), (be, fb, 3)]
+            cands += [(be, fb, c) for c in _BC_CANDS]
         if FULFILL_BULK is None:
             cands += [(be, False, bc)]
         if BULK_EVENTS is None:
@@ -275,7 +293,7 @@ def main() -> None:
             {
                 "metric": (
                     f"env_decision_steps_per_sec_{NUM_ENVS}envs_fair_"
-                    "synthetic_tpch"
+                    "synthetic_tpch" + _metric_suffix()
                 ),
                 "value": round(value, 1),
                 "unit": "steps/s",
@@ -318,8 +336,6 @@ def _wait_for_backend() -> None:
     programs.
     """
     import subprocess
-
-    global NUM_ENVS, SUB_BATCH
 
     plat = os.environ.get("JAX_PLATFORMS", "")
     if plat.split(",")[0] == "cpu":
@@ -373,23 +389,39 @@ def _wait_for_backend() -> None:
     )
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
-    global BULK_EVENTS, FULFILL_BULK, BULK_CYCLES
-    if "BENCH_NUM_ENVS" not in os.environ:
-        # keep the fallback bounded on a 1-core host; the metric name
-        # carries the lane count so this cannot be mistaken for the
-        # 1024-lane headline
-        NUM_ENVS = 256
-        SUB_BATCH = min(SUB_BATCH, NUM_ENVS)
-    # skip the multi-candidate calibration compile: minutes per
-    # candidate on one CPU core, and the driver's capture window is
-    # not guaranteed to wait. Pin any unset knob to the config the CPU
-    # probes measured best (PERF.md design responses 2/2b).
+    global BULK_EVENTS, FULFILL_BULK, SUB_BATCH, CPU_FALLBACK, _BC_CANDS
+    CPU_FALLBACK = True
+    # bound the calibration's execution cost on the 1-core host: bc=2
+    # is the only extra candidate that has ever won a CPU probe, and
+    # each candidate costs a warmup + calibration chunk at the full
+    # headline lane count (the capture window is not guaranteed to
+    # wait out three)
+    _BC_CANDS = (2,)
+    # round-5 fallback policy (VERDICT r4): keep the HEADLINE lane
+    # count so chipless-round numbers stay comparable across rounds —
+    # the round-4 fallback's uncalibrated 256-lane run reported an
+    # apples-to-oranges vs_baseline against the 1024-lane target. The
+    # metric name additionally gets a _cpufallback suffix (main()).
+    # Sub-batch <=256 keeps the per-map-step working set cache-friendly
+    # on a 1-core host; compile cost is per-SUB_BATCH (lane count only
+    # changes the lax.map trip count), and the round-5 pre-warm run
+    # committed 256-sub CPU cache entries so the driver's round-end
+    # capture compiles from cache. Clamp to a DIVISOR of NUM_ENVS so
+    # the import-time NUM_ENVS % SUB_BATCH invariant survives (e.g.
+    # BENCH_NUM_ENVS=384 must not clamp to 256).
+    if SUB_BATCH > 256:
+        SUB_BATCH = next(
+            d for d in range(256, 0, -1) if NUM_ENVS % d == 0
+        )
+    # pin the two knobs whose CPU-best setting is established and
+    # backend-stable (be=8/fb=1, PERF.md design responses 2/2b), but
+    # CALIBRATE bulk_cycles: it is the near-break-even knob whose best
+    # value moved between CPU probes (r4: +25% step-efficiency for
+    # +28% ops), and each candidate is one extra cached compile.
     if BULK_EVENTS is None:
         BULK_EVENTS = 8
     if FULFILL_BULK is None:
         FULFILL_BULK = True
-    if BULK_CYCLES is None:
-        BULK_CYCLES = 2
 
 
 if __name__ == "__main__":

@@ -37,25 +37,27 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 
-# set by the stage runner whenever an in-process stage (1-6) runs: all
-# of them touch the device, and a held client means a subprocess (stage
-# 7) could not acquire the chip grant. The private-registry check is
-# only a best-effort fallback for direct function calls.
+# set by every in-process stage (1-6) on entry: all of them touch the
+# device, and a held client means a subprocess (stage 7) could not
+# acquire the chip grant. No jax-internals fallback (round-4 advisor:
+# jax._src.xla_bridge._backends can silently change across upgrades,
+# making the guard pass falsely WHILE holding the chip) — the flag is
+# the single source of truth, and each stage function stamps it itself
+# so direct calls are covered, not just the __main__ runner.
 _CLIENT_HELD = False
 
 
-def _client_held() -> bool:
-    if _CLIENT_HELD:
-        return True
-    try:  # pragma: no cover - depends on jax internals
-        from jax._src import xla_bridge
+def _mark_client_held() -> None:
+    global _CLIENT_HELD
+    _CLIENT_HELD = True
 
-        return bool(xla_bridge._backends)
-    except Exception:
-        return False
+
+def _client_held() -> bool:
+    return _CLIENT_HELD
 
 
 def stage_sanity():
+    _mark_client_held()
     t0 = time.time()
     y = (jnp.ones((512, 512)) @ jnp.ones((512, 512))).sum()
     jax.block_until_ready(y)
@@ -64,24 +66,28 @@ def stage_sanity():
 
 
 def stage_sweep():
+    _mark_client_held()
     import scripts_burst_sweep
 
     scripts_burst_sweep.main()
 
 
 def stage_bulk_probe():
+    _mark_client_held()
     import scripts_bulk_probe
 
     scripts_bulk_probe.main()
 
 
 def stage_bench():
+    _mark_client_held()
     import bench
 
     bench.main()
 
 
 def stage_bench_decima():
+    _mark_client_held()
     import bench_decima
 
     bench_decima.bench_inference()
@@ -92,6 +98,7 @@ def stage_bench_decima():
 def stage_flagship():
     """Flagship-scale (decima_tpch.yaml env/agent shapes) compile + one
     tiny training iteration: 200-job cap, 50 executors, short scan."""
+    _mark_client_held()
     import yaml
 
     from sparksched_tpu.trainers.trainer import make_trainer
@@ -136,9 +143,10 @@ def stage_bench_1024():
               "client; run stage 7 as its own invocation", flush=True)
         return
     # no CPU fallback and a short wait: this stage exists ONLY to retry
-    # the 1024-lane sub-batch on the real chip — bench.py's default
-    # fallback would silently turn a wedged tunnel into a meaningless
-    # 256-lane CPU run that reports success
+    # the 1024-lane sub-batch on the real chip — bench.py's fallback
+    # (honestly labeled _cpufallback since round 5) would still burn
+    # this chip episode's window on a CPU run that answers nothing
+    # about the >=1024-lane kernel fault
     env = os.environ | {
         "BENCH_SUB_BATCH": "1024",
         "BENCH_CPU_FALLBACK": "0",
@@ -177,4 +185,4 @@ if __name__ == "__main__":
                 break
         finally:
             if p != "7":
-                _CLIENT_HELD = True
+                _mark_client_held()

@@ -13,21 +13,64 @@
 # wedge under that regime suggests aggressive polling may itself hold
 # the grant. Poll every 20 min with a generous 300 s timeout.
 #
-# CPU-side training is the PLATEAU continuation (scripts_plateau_train:
-# hold the from-scratch curve's iteration-250 peak - VERDICT round-3
-# item 5); it trains at the 10-exec scale, cheap enough for the 1-core
-# box. Flagship iterations are chip-only (CPU extrapolation from
-# PERF.md stage-5: days per iteration).
+# CPU-side training (round 5) is the 50-executor in-distribution
+# fine-tune (scripts_ft50_train.py — VERDICT round-4 item 2: stop
+# gating flagship-executor-scale training on the chip). Sized for the
+# 1-core box by the round-5 decision-count probes; full 200-job
+# flagship iterations remain chip-preferred (scripts_flagship_train.py
+# below).
 cd /root/repo
 rm -f /tmp/stop_chip_watch  # consume any stale stop request at launch
+# one-time legacy sweep: earlier-round trainers (tracked only by name,
+# pre-PID-file) must not survive into this watcher's lifetime — they
+# would contend the single core untracked and never be stopped for
+# chip windows. Safe from self-match here: this script's own cmdline
+# is "bash .../scripts_chip_watch.sh", which matches neither pattern.
+pkill -f "scripts_ft_continue.py" 2>/dev/null
+pkill -f "scripts_plateau_train.py" 2>/dev/null
+
+# The CPU trainer is tracked by PID file, not pkill -f: pkill patterns
+# self-match wrapper shells in this harness, and \|-alternation in a
+# pkill ERE is a literal (round-4 advisor finding) — both made the old
+# pattern kill either nothing or the caller.
+CPU_TRAINER_PID=/tmp/cpu_trainer.pid
+
+cpu_trainer_alive() {
+  # identity-checked liveness: a recycled PID must not make the watcher
+  # adopt (or later SIGTERM) an unrelated process
+  [ -f "$CPU_TRAINER_PID" ] \
+    && p="$(cat "$CPU_TRAINER_PID")" \
+    && kill -0 "$p" 2>/dev/null \
+    && tr '\0' ' ' < "/proc/$p/cmdline" 2>/dev/null \
+       | grep -q "scripts_ft50_train"
+}
+
+stop_cpu_trainer() {
+  if cpu_trainer_alive; then
+    kill "$(cat "$CPU_TRAINER_PID")" 2>/dev/null
+    sleep 2
+  fi
+  # belt-and-braces: an ft50 instance NOT recorded in the PID file
+  # (hand-launched, PID file lost) must still yield the core to a chip
+  # window. Safe from self-match: this script's cmdline is
+  # "bash .../scripts_chip_watch.sh".
+  pkill -f "scripts_ft50_train.py" 2>/dev/null
+}
+
+# stale-PID-file cleanup AFTER the liveness helper exists: a PID file
+# whose process is a live ft50 trainer is ADOPTED (a watcher restart
+# must not orphan its predecessor's trainer and spawn a duplicate);
+# anything else is stale and removed so a recycled PID is never pinned.
+cpu_trainer_alive || rm -f "$CPU_TRAINER_PID"
 
 restart_cpu_trainer() {
-  # plateau run complete (curve 250->500, EVAL.md); CPU now continues
-  # the fine-tuned artifact under the corrected schedules
-  if ! pgrep -f "scripts_ft_continue" > /dev/null; then
-    JAX_PLATFORMS=cpu nohup nice -n 10 python scripts_ft_continue.py \
-      4 25 >> /tmp/ft_continue.log 2>&1 &
-    echo "cpu ft-continuation trainer restarted (pid $!) at $(date +%H:%M:%S)"
+  # round-5 CPU work: in-distribution fine-tune at the 50-executor
+  # flagship scale (VERDICT round-4 item 2)
+  if ! cpu_trainer_alive; then
+    JAX_PLATFORMS=cpu nohup nice -n 10 python scripts_ft50_train.py \
+      8 10 >> /tmp/ft50.log 2>&1 &
+    echo "$!" > "$CPU_TRAINER_PID"
+    echo "cpu ft50 trainer restarted (pid $!) at $(date +%H:%M:%S)"
   fi
 }
 
@@ -43,8 +86,7 @@ print('ALIVE')
     echo "chip alive at $(date +%H:%M:%S); running session"
     # stop the CPU trainer for the chip window: compiles and host-side
     # scan glue need the single core
-    pkill -f "scripts_plateau_train\|scripts_ft_continue" 2>/dev/null
-    sleep 2
+    stop_cpu_trainer
     timeout -k 60 3600 python scripts_chip_session.py 1 3
     echo "session rc=$? at $(date +%H:%M:%S)"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
@@ -65,7 +107,7 @@ print('ALIVE')
   else
     echo "watch $i: wedged at $(date +%H:%M:%S)"
   fi
-  # idempotent (pgrep-guarded): also revives a trainer that crashed
+  # idempotent (PID-file-guarded): also revives a trainer that crashed
   # during a tunnel wedge, not just after a chip episode
   restart_cpu_trainer
   sleep 1200

@@ -1,0 +1,88 @@
+"""Flagship-executor-scale (50-exec) in-distribution fine-tune.
+
+Round-4 evidence (EVAL_FLAGSHIP.md): policies trained at 10 executors
+transfer to the 50-executor flagship scale of config/decima_tpch.yaml
+with only +4.8..+7.0% over fair, and better 10-exec checkpoints
+transfer WORSE — in-distribution gains do not buy executor-scale
+transfer. This runner closes the gap from the training side: PPO
+fine-tuning AT the 50-executor / 50-job evaluation distribution
+(the reference's published model was trained at 50 executors,
+reference config/decima_tpch.yaml:80-87), warm-started from an
+existing checkpoint, under the corrected late-training schedules that
+held the round-4 plateau (scripts_plateau_train.py's diagnosis: lr
+floor, flat 0.01 entropy, tight target_kl).
+
+Sizing (round-5 probes): a fair-driven 50-exec/50-job episode
+completes in 650-810 decisions, but DECIMA-driven episodes need
+1100-1400 (exec-limit actions create more commitment rounds), so
+rollout_steps=2000 covers them with drift margin — NOT the
+3*jobs*execs=7500 the eval cap uses. 2x4 lanes x 2000 steps is a
+~16k-decision iteration batch (the successful 10-exec runs used
+9.6k), roughly 15-25 min per iteration on the 1-core CPU box.
+
+Usage: python scripts_ft50_train.py [sessions] [iters_per_session]
+Env FT50_WARM_START overrides the warm-start checkpoint.
+Artifacts under artifacts/decima_ft50; latest params also written to
+models/decima/model_ft50.msgpack. Evaluate with
+  EVAL_EXECS=50 EVAL_JOBS=50 EVAL_STEPS=2400 \
+      python scripts_eval_decima.py 12 \
+      models/decima/model_ft50.msgpack EVAL_FLAGSHIP.md
+"""
+
+import os
+import sys
+
+sys.path.insert(0, "/root/repo")
+from sparksched_tpu.config import (  # noqa: E402
+    enable_compilation_cache,
+    honor_jax_platforms_env,
+)
+
+honor_jax_platforms_env()
+enable_compilation_cache()
+
+WARM_START = os.environ.get(
+    "FT50_WARM_START", "/root/repo/models/decima/model_tpu.msgpack"
+)
+
+
+def make_cfg(iters: int) -> dict:
+    from scripts_scratch_train import make_cfg as scratch_cfg
+
+    cfg = scratch_cfg("ft50", iters)
+    cfg["trainer"] |= {
+        "artifacts_dir": "/root/repo/artifacts/decima_ft50",
+        "checkpointing_freq": 10,
+        # 2x4 lanes x 2000 steps: covers decima-driven episode length
+        # (probe: 1100-1400 decisions) with drift margin
+        "num_sequences": 2,
+        "num_rollouts": 4,
+        "rollout_steps": 2000,
+        # corrected late-training schedules (scripts_ft_continue.py)
+        "entropy_coeff": 0.01,
+        "entropy_anneal": None,
+        "target_kl": 0.007,
+        "opt_kwargs": {"lr": 6.0e-5},
+        "lr_anneal": {"final": 2.0e-5, "steps": 1500},
+    }
+    cfg["env"] |= {"num_executors": 50, "job_arrival_cap": 50}
+    cfg["agent"]["state_dict_path"] = WARM_START
+    return cfg
+
+
+def run(sessions: int, iters: int) -> None:
+    from scripts_scratch_train import run_sessions
+
+    run_sessions(
+        make_cfg(iters),
+        "/root/repo/models/decima/model_ft50.msgpack",
+        sessions,
+        label="ft50 session",
+    )
+
+
+if __name__ == "__main__":
+    run(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 8,
+        int(sys.argv[2]) if len(sys.argv) > 2 else 10,
+    )
