@@ -33,6 +33,18 @@ from sparksched_tpu.config import (
 honor_jax_platforms_env()
 enable_compilation_cache()
 
+# match bench.py's __main__ PRNG config (BENCH_PRNG, default rbg) for
+# the in-process stage_bench/stage_bench_decima calls: they invoke
+# bench.main() directly, skipping bench.py's __main__ block, and a
+# chip-session headline number measured under threefry would not be
+# comparable with the rbg rows in PERF.md/BENCH_r*.json
+import os as _os  # noqa: E402
+
+if _os.environ.get("BENCH_PRNG", "rbg") == "rbg":
+    from sparksched_tpu.config import use_fast_prng as _ufp
+
+    _ufp()
+
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 

@@ -48,13 +48,15 @@ cpu_trainer_alive() {
 stop_cpu_trainer() {
   if cpu_trainer_alive; then
     kill "$(cat "$CPU_TRAINER_PID")" 2>/dev/null
-    sleep 2
   fi
   # belt-and-braces: an ft50 instance NOT recorded in the PID file
   # (hand-launched, PID file lost) must still yield the core to a chip
   # window. Safe from self-match: this script's cmdline is
   # "bash .../scripts_chip_watch.sh".
   pkill -f "scripts_ft50_train.py" 2>/dev/null
+  # settle delay for EITHER kill path: the SIGTERM'd JAX trainer needs
+  # a moment to tear down before a chip session claims the core
+  sleep 2
 }
 
 # stale-PID-file cleanup AFTER the liveness helper exists: a PID file

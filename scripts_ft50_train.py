@@ -41,8 +41,15 @@ from sparksched_tpu.config import (  # noqa: E402
 honor_jax_platforms_env()
 enable_compilation_cache()
 
+# round-5 bake-off at the 50-exec/50-job eval setting (12 held-out
+# seeds, artifacts/eval_curve/bakeoff_50exec.md): converted reference
+# checkpoint +10.3% 12/12 > model_ft +7.5% 9/12 > model_tpu +7.0% 7/12
+# > ft_plateau +4.8% 5/12 — the checkpoint the reference itself trained
+# at 50 executors transfers best, so it is the warm start to beat;
+# fine-tuning it in-distribution aims the artifact ABOVE the
+# reference's own published model at the reference's own scale.
 WARM_START = os.environ.get(
-    "FT50_WARM_START", "/root/repo/models/decima/model_tpu.msgpack"
+    "FT50_WARM_START", "/root/reference/models/decima/model.pt"
 )
 
 

@@ -258,7 +258,7 @@ def _apply_action(
     tpl = state.job_template[tj]
     num_local = (state.exec_job == tj).sum()
     dur = sample_task_duration(
-        params, bank, sub, tpl, ts, num_local,
+        params, bank, jax.random.uniform(sub, (2,)), tpl, ts, num_local,
         state.exec_task_valid[e], state.exec_task_stage[e] == ts,
     )
 
@@ -570,15 +570,18 @@ def _bulk_fulfill(
     nl = base_nl - jnp.where(dj == src_j, leavers_before, 0)
 
     rng_next, sub = jax.random.split(state.rng)
-    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(sub, pos)
+    # one batched draw for the whole pass (rows were independently
+    # keyed via per-row fold_in before; independent uniforms now — see
+    # sample_task_duration's docstring for the round-5 measurement)
+    us = jax.random.uniform(sub, (pos.shape[0], 2))
     tpl = state.job_template[djc]
     tv = state.exec_task_valid[e]
     ss_same = state.exec_task_stage[e] == ds0
     durs = jax.vmap(
-        lambda key, tp, s_, nl_, tv_, sm_: sample_task_duration(
-            params, bank, key, tp, s_, nl_, tv_, sm_,
+        lambda u2, tp, s_, nl_, tv_, sm_: sample_task_duration(
+            params, bank, u2, tp, s_, nl_, tv_, sm_,
         )
-    )(keys, tpl, dsc, nl, tv, ss_same)
+    )(us, tpl, dsc, nl, tv, ss_same)
 
     inc = (start | send).astype(_i32)
     seq_k = state.seq_counter + (earlier & (inc[None, :] > 0)).sum(-1)
@@ -1066,16 +1069,16 @@ def _bulk_relaunch(
     # discarded. Deterministic banks (the parity fixtures) are
     # unaffected. rng advances once iff the bulk fires.
     rng_next, sub = jax.random.split(state.rng)
-    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
-        sub, jnp.arange(max_events * n)
-    )
+    # one batched draw for the whole table (per-row fold_in keys
+    # before; independent uniforms now — sample_task_duration docstring)
+    us = jax.random.uniform(sub, (max_events * n, 2))
     e_rep = jnp.tile(pos, max_events)
     dur_table = jax.vmap(
-        lambda key, e: sample_task_duration(
-            params, bank, key, tpl[e], sc[e], num_local[e],
+        lambda u2, e: sample_task_duration(
+            params, bank, u2, tpl[e], sc[e], num_local[e],
             jnp.bool_(True), jnp.bool_(True),
         )
-    )(keys, e_rep).reshape(max_events, n)
+    )(us, e_rep).reshape(max_events, n)
 
     def step_fn(carry, dur_row):
         t_e, sq_e, rem_e, k_e, ldur_e, counter, wall, active, crossed \
@@ -1265,15 +1268,17 @@ def _bulk_ready(
     nl = base_nl + (earlier & same_job).sum(-1) + 1
 
     rng_next, sub = jax.random.split(state.rng)
-    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(sub, pos)
+    # one batched draw for the whole pass (sample_task_duration
+    # docstring has the round-5 measurement behind this form)
+    us = jax.random.uniform(sub, (pos.shape[0], 2))
     tpl = state.job_template[djc]
     tv = state.exec_task_valid[jnp.clip(e, 0, n - 1)]
     ss_same = state.exec_task_stage[jnp.clip(e, 0, n - 1)] == ds0
     durs = jax.vmap(
-        lambda key, tp, s_, nl_, tv_, sm_: sample_task_duration(
-            params, bank, key, tp, s_, nl_, tv_, sm_,
+        lambda u2, tp, s_, nl_, tv_, sm_: sample_task_duration(
+            params, bank, u2, tp, s_, nl_, tv_, sm_,
         )
-    )(keys, tpl, dsc, nl, tv, ss_same)
+    )(us, tpl, dsc, nl, tv, ss_same)
     fin_k = to + durs
 
     before_star = (to < t_star) | ((to == t_star) & (so < seq_star))

@@ -43,17 +43,23 @@ def sample_job_sequence(
 
 
 def sample_executor_key(
-    bank: WorkloadBank, rng: jax.Array, template: jnp.ndarray,
+    bank: WorkloadBank, u: jnp.ndarray, template: jnp.ndarray,
     stage: jnp.ndarray, num_local: jnp.ndarray
 ) -> jnp.ndarray:
     """Map the executor count to a trace executor-level index, randomly
     interpolating between the two bracketing levels and falling back to the
-    max level present for this stage (reference tpch.py:216-235)."""
+    max level present for this stage (reference tpch.py:216-235).
+
+    `u` is a pre-drawn Uniform[0,1) scalar, NOT a PRNG key: the round-5
+    CPU decomposition measured the per-call rng plumbing (fold_in +
+    split + uniform + randint per sampled task) at ~31% of the whole
+    flat micro-step, while the bank-table gathers were free. Callers
+    draw ONE batched uniform array per bulk pass and hand each row's
+    slice down (see `sample_task_duration`)."""
     left_v = bank.itv_left_val[num_local]
     right_v = bank.itv_right_val[num_local]
     left_i = bank.itv_left_idx[num_local]
     right_i = bank.itv_right_idx[num_local]
-    u = jax.random.uniform(rng)
     rand_pt = 1 + (u * (right_v - left_v)).astype(jnp.int32)
     use_left = (left_v == right_v) | (rand_pt <= num_local - left_v)
     key_idx = jnp.where(use_left, left_i, right_i)
@@ -66,7 +72,7 @@ def sample_executor_key(
 
 
 def sample_task_duration(
-    params: EnvParams, bank: WorkloadBank, rng: jax.Array,
+    params: EnvParams, bank: WorkloadBank, u2: jnp.ndarray,
     template: jnp.ndarray, stage: jnp.ndarray, num_local: jnp.ndarray,
     task_valid: jnp.ndarray, same_stage: jnp.ndarray
 ) -> jnp.ndarray:
@@ -80,9 +86,17 @@ def sample_task_duration(
     - executor new to this stage: first_wave, else fresh_durations.
 
     A final fallback to the stage's rough mean duration replaces the
-    reference's uncaught exception when a bucket is entirely empty."""
-    k_key, k_pick = jax.random.split(rng)
-    li = sample_executor_key(bank, k_key, template, stage, num_local)
+    reference's uncaught exception when a bucket is entirely empty.
+
+    `u2` is f32[2] of pre-drawn Uniform[0,1) variates (NOT a key):
+    u2[0] drives the executor-level interpolation, u2[1] the
+    within-bucket pick. Hot callers (`_apply_action` and the three bulk
+    passes in env/core.py) draw one batched uniform per pass — the
+    per-row key plumbing this replaces was ~31% of the flat micro-step
+    on the CPU backend (round-5 ablation), with identical per-row
+    distributions (rows were independently keyed before, independent
+    uniforms now; `pick = floor(u*n)` matches randint's law)."""
+    li = sample_executor_key(bank, u2[0], template, stage, num_local)
 
     cnt = bank.cnt[template, stage, :, li]  # i32[3]
     has = cnt > 0
@@ -102,7 +116,7 @@ def sample_task_duration(
     warm = jnp.where(~task_valid, idle_warm, False)
 
     n = jnp.maximum(cnt[wave], 1)
-    pick = jax.random.randint(k_pick, (), 0, n)
+    pick = jnp.minimum((u2[1] * n).astype(jnp.int32), n - 1)
     dur = bank.dur[template, stage, wave, li, pick]
     dur = jnp.where(
         cnt[wave] > 0, dur, bank.rough_duration[template, stage]
