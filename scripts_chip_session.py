@@ -102,9 +102,21 @@ def stage_bench_decima():
     _mark_client_held()
     import bench_decima
 
-    bench_decima.bench_inference()
-    bench_decima.bench_inference(compute_dtype="bfloat16")
-    bench_decima.bench_ppo()
+    # per-row guards: round-3 session 1 and round-5 session 1 each lost
+    # ALL decima rows to a single remote-compile failure (UNAVAILABLE)
+    # on the first program — every row is independent evidence, so a
+    # dead row must not take the rest of the stage with it
+    for label, row in (
+        ("infer f32", lambda: bench_decima.bench_inference()),
+        ("infer bf16",
+         lambda: bench_decima.bench_inference(compute_dtype="bfloat16")),
+        ("ppo", lambda: bench_decima.bench_ppo()),
+    ):
+        try:
+            row()
+        except Exception:
+            print(f"[bench-decima] row '{label}' failed:", flush=True)
+            traceback.print_exc()
 
 
 def stage_flagship():

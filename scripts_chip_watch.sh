@@ -92,15 +92,20 @@ print('ALIVE')
     timeout -k 60 3600 python scripts_chip_session.py 1 3
     echo "session rc=$? at $(date +%H:%M:%S)"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
-    # flagship-scale training BEFORE the decima benches: VERDICT ranks
-    # it higher, and round 3's tunnel window died inside a decima-bench
-    # compile. Short resumable sessions (state saved every session; a
-    # wedge mid-session loses at most iters_per_session iterations).
-    timeout -k 60 7200 python scripts_flagship_train.py 20 2
-    echo "flagship rc=$? at $(date +%H:%M:%S)"
-    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # round-5 reorder: decima benches BEFORE flagship training. The
+    # round-5 session-1 window measured the headline then closed
+    # ~25 min in, mid decima-compile — windows are too short to put a
+    # 2 h training session ahead of the three short evidence rows the
+    # VERDICT explicitly asks for (stage 4 is now per-row guarded, so
+    # one dead compile no longer forfeits the stage).
     timeout -k 60 2700 python scripts_chip_session.py 4
     echo "decima-bench rc=$? at $(date +%H:%M:%S)"
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # flagship-scale training with whatever window remains: resumable
+    # sessions (state saved every session; a wedge mid-session loses at
+    # most iters_per_session iterations).
+    timeout -k 60 7200 python scripts_flagship_train.py 20 2
+    echo "flagship rc=$? at $(date +%H:%M:%S)"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # fault-risk 1024-lane probe LAST in the chip episode: if it wedges
     # the tunnel, nothing else in this window is lost
