@@ -92,6 +92,13 @@ TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 # every candidate costs a warmup + calibration chunk at full lane
 # count, and bc=3 has never won a CPU probe (PERF.md round-4 table)
 _BC_CANDS = (2, 3)
+# extra bulk_events (cascade scan length) values tried when
+# BENCH_BULK_EVENTS is unset: round-5 session 1 measured a 2x swing
+# between be=8 and be=0 on chip, so the scan length is a live knob —
+# but only be∈{8,0} had ever been calibrated. The unattended CPU
+# fallback never tries these: it pins BULK_EVENTS=8 outright
+# (_wait_for_backend), which skips the whole candidate expansion.
+_BE_CANDS = (4, 16)
 # set by _wait_for_backend when the accelerator never answered and the
 # run proceeded on host CPU. main() suffixes the metric name whenever
 # the executing backend is CPU — "_cpufallback" for the unattended
@@ -210,7 +217,9 @@ def main() -> None:
         if FULFILL_BULK is None:
             cands += [(be, False, bc)]
         if BULK_EVENTS is None:
-            # no-bulk baseline, holding any explicitly pinned knobs
+            # alternate cascade lengths, then the no-bulk baseline,
+            # holding any explicitly pinned knobs
+            cands += [(b, fb, bc) for b in _BE_CANDS]
             cands += [(0, fb, bc)]
         cands = list(dict.fromkeys(cands))
     keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
@@ -395,7 +404,8 @@ def _wait_for_backend() -> None:
     # is the only extra candidate that has ever won a CPU probe, and
     # each candidate costs a warmup + calibration chunk at the full
     # headline lane count (the capture window is not guaranteed to
-    # wait out three)
+    # wait out three). (_BE_CANDS needs no pruning here: the
+    # BULK_EVENTS=8 pin below already removes its consuming branch.)
     _BC_CANDS = (2,)
     # round-5 fallback policy (VERDICT r4): keep the HEADLINE lane
     # count so chipless-round numbers stay comparable across rounds —
