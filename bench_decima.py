@@ -96,7 +96,10 @@ def bench_inference(
     }), flush=True)
 
 
-def bench_ppo(num_envs: int = 1024, rollout_steps: int = 256) -> None:
+def bench_ppo(
+    num_envs: int = 1024, rollout_steps: int = 256,
+    compute_dtype: str | None = None,
+) -> None:
     cfg_agent = {
         "agent_cls": "DecimaScheduler",
         "embed_dim": 16,
@@ -106,6 +109,11 @@ def bench_ppo(num_envs: int = 1024, rollout_steps: int = 256) -> None:
             "act_kwargs": {"negative_slope": 0.2},
         },
         "policy_mlp_kwargs": {"hid_dims": [64, 64], "act_cls": "Tanh"},
+        # bf16 matmuls with f32 params/optimizer: the same knob the
+        # shipped config documents for training (README); the net is
+        # shared by the rollout policy and evaluate_actions, so the
+        # whole collect+update path runs MXU-native under it
+        "compute_dtype": compute_dtype,
     }
     cfg_env = {
         "num_executors": 10,
@@ -163,8 +171,9 @@ def bench_ppo(num_envs: int = 1024, rollout_steps: int = 256) -> None:
         total += int(jax.block_until_ready(ro.valid).sum())
     dt = time.perf_counter() - t0
     value = total / dt
+    tag = f"_{compute_dtype}" if compute_dtype else ""
     print(json.dumps({
-        "metric": f"ppo_train_steps_per_sec_{num_envs}envs",
+        "metric": f"ppo_train_steps_per_sec_{num_envs}envs{tag}",
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
@@ -199,3 +208,7 @@ if __name__ == "__main__":
     bench_inference(num_envs=infer_envs)
     bench_inference(num_envs=infer_envs, compute_dtype="bfloat16")
     bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps)
+    bench_ppo(
+        num_envs=ppo_envs, rollout_steps=ppo_steps,
+        compute_dtype="bfloat16",
+    )

@@ -111,6 +111,8 @@ def stage_bench_decima():
         ("infer bf16",
          lambda: bench_decima.bench_inference(compute_dtype="bfloat16")),
         ("ppo", lambda: bench_decima.bench_ppo()),
+        ("ppo bf16",
+         lambda: bench_decima.bench_ppo(compute_dtype="bfloat16")),
     ):
         try:
             row()
