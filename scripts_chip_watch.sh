@@ -89,8 +89,22 @@ print('ALIVE')
     # stop the CPU trainer for the chip window: compiles and host-side
     # scan glue need the single core
     stop_cpu_trainer
-    timeout -k 60 3600 python scripts_chip_session.py 1 3
-    echo "session rc=$? at $(date +%H:%M:%S)"
+    # headline bench at most ONCE per watcher lifetime (windows are
+    # ~25 min; round-5 session 1 already committed an on-chip headline,
+    # so later windows belong to the decima benches and flagship
+    # training — one more stage-3 pass re-measures under the widened
+    # be∈{4,8,16} calibration, then the marker stops repeats)
+    HEADLINE_MARK=/tmp/headline_r05_remeasured
+    if [ ! -f "$HEADLINE_MARK" ]; then
+      timeout -k 60 3600 python scripts_chip_session.py 1 3 \
+        | tee /tmp/stage3_last.log
+      echo "session rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q '"backend": "tpu"' /tmp/stage3_last.log \
+        && touch "$HEADLINE_MARK"
+    else
+      timeout -k 60 600 python scripts_chip_session.py 1
+      echo "sanity rc=$? at $(date +%H:%M:%S)"
+    fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # round-5 reorder: decima benches BEFORE flagship training. The
     # round-5 session-1 window measured the headline then closed
