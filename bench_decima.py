@@ -8,7 +8,12 @@ Prints one JSON line per configuration:
 Unlike bench.py (the driver's single headline metric), this script
 records the Decima-path numbers VERDICT r1 flagged as missing: policy
 inference throughput in the rollout loop, and end-to-end PPO training
-throughput (collect + update) per decision step.
+throughput (collect + update) per decision step. Since round 6 each
+measurement runs on a selectable rollout engine — `core` (per-decision
+`core.step` scan) or `flat` (the flat micro-step engine,
+trainers/rollout.py:collect_flat_sync) — and EVERY emitted row records
+`engine` and `backend` in its config so a CPU-fallback artifact can
+never be mistaken for a chip number.
 
 Reference anchors: examples.py:64-81 (Decima episode), trainers
 rollout/PPO pipeline (trainer.py:85-162); neither publishes numbers
@@ -27,16 +32,40 @@ from sparksched_tpu.config import EnvParams
 from sparksched_tpu.env import core
 from sparksched_tpu.schedulers import DecimaScheduler
 from sparksched_tpu.trainers.ppo import PPO
-from sparksched_tpu.trainers.rollout import collect_sync
+from sparksched_tpu.trainers.rollout import (
+    collect_flat_sync,
+    collect_sync,
+    flat_micro_group_budget,
+)
 from sparksched_tpu.workload import make_workload_bank
 
 TARGET = 50_000.0
 
 
+def _flat_knobs() -> dict:
+    """Flat-engine calibration knobs for the decima_flat rows (same
+    env-var override style as bench.py's self-calibration surface)."""
+    return {
+        "event_burst": int(os.environ.get("DEC_BENCH_FLAT_BURST", 4)),
+        "bulk_events": int(os.environ.get("DEC_BENCH_FLAT_EVENTS", 8)),
+        # on by default: FULFILL micro-steps only advance in full
+        # micro-steps, so with a burst every un-bulked fulfillment costs
+        # a whole burst-sized group (PERF.md round-6 calibration)
+        "fulfill_bulk": bool(int(
+            os.environ.get("DEC_BENCH_FLAT_FULFILL", 1)
+        )),
+        "bulk_cycles": int(os.environ.get("DEC_BENCH_FLAT_CYCLES", 1)),
+    }
+
+
 def bench_inference(
     num_envs: int = 64, steps: int = 512,
-    compute_dtype: str | None = None,
+    compute_dtype: str | None = None, engine: str = "core",
 ) -> None:
+    """Rollout-collection throughput (valid decision steps/s). `engine`
+    selects the collector: "core" = per-decision `collect_sync` scan,
+    "flat" = `collect_flat_sync` over the flat micro-step engine (the
+    decima_flat row; knobs from `_flat_knobs`)."""
     params = EnvParams(
         num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
         moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
@@ -59,14 +88,29 @@ def bench_inference(
         compute_dtype=compute_dtype,
     )
 
-    def pol(rng, obs):
-        return sched.policy(rng, obs, sched.params)
+    pol = sched.flat_policy()
+    knobs = _flat_knobs()
+    micro_per_dec = float(os.environ.get("DEC_BENCH_FLAT_MICRO", 4.0))
 
-    @jax.jit
-    def run(states, rngs):
-        return jax.vmap(
-            lambda r, s: collect_sync(params, bank, pol, r, steps, s)
-        )(rngs, states)
+    if engine == "flat":
+        micro_groups = flat_micro_group_budget(
+            steps, micro_per_dec, knobs["event_burst"]
+        )
+
+        @jax.jit
+        def run(states, rngs):
+            return jax.vmap(
+                lambda r, s: collect_flat_sync(
+                    params, bank, pol, r, steps, s,
+                    micro_groups=micro_groups, **knobs,
+                )
+            )(rngs, states)
+    else:
+        @jax.jit
+        def run(states, rngs):
+            return jax.vmap(
+                lambda r, s: collect_sync(params, bank, pol, r, steps, s)
+            )(rngs, states)
 
     keys = jax.random.split(jax.random.PRNGKey(0), num_envs)
     states = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
@@ -83,22 +127,28 @@ def bench_inference(
     dt = time.perf_counter() - t0
     value = total / dt
     tag = f"_{compute_dtype}" if compute_dtype else ""
+    eng_tag = "_flat" if engine == "flat" else ""
+    cfg = {
+        "num_envs": num_envs,
+        "engine": engine,
+        "prng_impl": str(jax.config.jax_default_prng_impl),
+        "backend": jax.default_backend(),
+    }
+    if engine == "flat":
+        cfg |= {"micro_per_decision": micro_per_dec} | knobs
     print(json.dumps({
-        "metric": f"decima_infer_steps_per_sec_{num_envs}envs{tag}",
+        "metric": f"decima_infer_steps_per_sec_{num_envs}envs{tag}"
+                  f"{eng_tag}",
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
-        "config": {
-            "num_envs": num_envs,
-            "prng_impl": str(jax.config.jax_default_prng_impl),
-            "backend": jax.default_backend(),
-        },
+        "config": cfg,
     }), flush=True)
 
 
 def bench_ppo(
     num_envs: int = 1024, rollout_steps: int = 256,
-    compute_dtype: str | None = None,
+    compute_dtype: str | None = None, engine: str = "core",
 ) -> None:
     cfg_agent = {
         "agent_cls": "DecimaScheduler",
@@ -148,7 +198,19 @@ def bench_ppo(
         # match the shipped flagship config (and bench.py's default);
         # BENCH_PRNG=threefry overrides, as in bench.py
         "fast_prng": os.environ.get("BENCH_PRNG", "rbg") == "rbg",
+        "rollout_engine": engine,
     }
+    if engine == "flat":
+        knobs = _flat_knobs()
+        cfg_train |= {
+            "flat_micro_per_decision": float(
+                os.environ.get("DEC_BENCH_FLAT_MICRO", 4.0)
+            ),
+            "flat_event_burst": knobs["event_burst"],
+            "flat_bulk_events": knobs["bulk_events"],
+            "flat_fulfill_bulk": knobs["fulfill_bulk"],
+            "flat_bulk_cycles": knobs["bulk_cycles"],
+        }
     trainer = PPO(cfg_agent, cfg_env, cfg_train)
     state = trainer.init_state()
 
@@ -172,14 +234,16 @@ def bench_ppo(
     dt = time.perf_counter() - t0
     value = total / dt
     tag = f"_{compute_dtype}" if compute_dtype else ""
+    eng_tag = "_flat" if engine == "flat" else ""
     print(json.dumps({
-        "metric": f"ppo_train_steps_per_sec_{num_envs}envs{tag}",
+        "metric": f"ppo_train_steps_per_sec_{num_envs}envs{tag}{eng_tag}",
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
         "config": {
             "num_envs": num_envs,
             "rollout_steps": rollout_steps,
+            "engine": engine,
             "prng_impl": str(jax.config.jax_default_prng_impl),
             "backend": jax.default_backend(),
         },
@@ -203,12 +267,21 @@ if __name__ == "__main__":
     # masquerade as the chip-scale row); defaults are the BASELINE.md
     # config #3/#4 scales
     infer_envs = int(os.environ.get("DEC_BENCH_INFER_ENVS", 64))
+    infer_steps = int(os.environ.get("DEC_BENCH_INFER_STEPS", 512))
     ppo_envs = int(os.environ.get("DEC_BENCH_PPO_ENVS", 1024))
     ppo_steps = int(os.environ.get("DEC_BENCH_PPO_STEPS", 256))
-    bench_inference(num_envs=infer_envs)
-    bench_inference(num_envs=infer_envs, compute_dtype="bfloat16")
+    bench_inference(num_envs=infer_envs, steps=infer_steps)
+    bench_inference(
+        num_envs=infer_envs, steps=infer_steps, compute_dtype="bfloat16"
+    )
+    bench_inference(num_envs=infer_envs, steps=infer_steps, engine="flat")
+    bench_inference(
+        num_envs=infer_envs, steps=infer_steps, compute_dtype="bfloat16",
+        engine="flat",
+    )
     bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps)
     bench_ppo(
         num_envs=ppo_envs, rollout_steps=ppo_steps,
         compute_dtype="bfloat16",
     )
+    bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps, engine="flat")

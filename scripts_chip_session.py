@@ -15,6 +15,8 @@ Stages (each guarded; a failure logs and moves on):
      in-process stages): a >=1024-lane kernel fault can wedge the
      tunnel, and a parent that already holds the device client would
      starve the subprocess of the chip grant.
+  8. Decima flat-engine benches (rollout collection via the flat
+     micro-step engine + flat-collector PPO)
 
 Usage: python scripts_chip_session.py [stage ...]   (default: 1 2 3 4)
 """
@@ -98,27 +100,62 @@ def stage_bench():
     bench.main()
 
 
+def _run_bench_rows(name: str, rows) -> None:
+    """Per-row guards: round-3 session 1 and round-5 session 1 each lost
+    ALL decima rows to a single remote-compile failure (UNAVAILABLE) on
+    the first program — every row is independent evidence, so a dead row
+    must not take the rest of the stage with it. But a WEDGED tunnel is
+    not row-local (round-5 advisor): an UNAVAILABLE error, or two
+    consecutive failures of any kind, means later rows would each burn a
+    full compile attempt against a dead backend — bail out instead."""
+    consecutive = 0
+    for label, row in rows:
+        try:
+            row()
+            consecutive = 0
+        except Exception as e:
+            print(f"[{name}] row '{label}' failed:", flush=True)
+            traceback.print_exc()
+            consecutive += 1
+            if "UNAVAILABLE" in str(e):
+                print(f"[{name}] UNAVAILABLE (wedged tunnel); "
+                      "abandoning remaining rows", flush=True)
+                return
+            if consecutive >= 2:
+                print(f"[{name}] {consecutive} consecutive failures; "
+                      "abandoning remaining rows", flush=True)
+                return
+
+
 def stage_bench_decima():
     _mark_client_held()
     import bench_decima
 
-    # per-row guards: round-3 session 1 and round-5 session 1 each lost
-    # ALL decima rows to a single remote-compile failure (UNAVAILABLE)
-    # on the first program — every row is independent evidence, so a
-    # dead row must not take the rest of the stage with it
-    for label, row in (
+    _run_bench_rows("bench-decima", (
         ("infer f32", lambda: bench_decima.bench_inference()),
         ("infer bf16",
          lambda: bench_decima.bench_inference(compute_dtype="bfloat16")),
         ("ppo", lambda: bench_decima.bench_ppo()),
         ("ppo bf16",
          lambda: bench_decima.bench_ppo(compute_dtype="bfloat16")),
-    ):
-        try:
-            row()
-        except Exception:
-            print(f"[bench-decima] row '{label}' failed:", flush=True)
-            traceback.print_exc()
+    ))
+
+
+def stage_bench_decima_flat():
+    """decima_flat rows (round 6): Decima rollout collection routed
+    through the flat micro-step engine — the training fast path — plus
+    the flat-collector PPO end-to-end row."""
+    _mark_client_held()
+    import bench_decima
+
+    _run_bench_rows("bench-decima-flat", (
+        ("infer flat f32",
+         lambda: bench_decima.bench_inference(engine="flat")),
+        ("infer flat bf16",
+         lambda: bench_decima.bench_inference(
+             compute_dtype="bfloat16", engine="flat")),
+        ("ppo flat", lambda: bench_decima.bench_ppo(engine="flat")),
+    ))
 
 
 def stage_flagship():
@@ -194,6 +231,7 @@ STAGES = {
     "5": ("flagship check", stage_flagship),
     "6": ("bulk probe", stage_bulk_probe),
     "7": ("headline bench, sub-batch 1024", stage_bench_1024),
+    "8": ("decima flat-engine benches", stage_bench_decima_flat),
 }
 
 

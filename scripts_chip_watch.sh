@@ -21,6 +21,10 @@
 # below).
 cd /root/repo
 rm -f /tmp/stop_chip_watch  # consume any stale stop request at launch
+# true per-lifetime headline semantics (round-5 advisor): the re-measure
+# marker must not survive watcher restarts, or a restarted watcher in
+# the same round never re-measures after calibration changes
+rm -f /tmp/headline_r05_remeasured
 # one-time legacy sweep: earlier-round trainers (tracked only by name,
 # pre-PID-file) must not survive into this watcher's lifetime — they
 # would contend the single core untracked and never be stopped for
@@ -93,7 +97,9 @@ print('ALIVE')
     # ~25 min; round-5 session 1 already committed an on-chip headline,
     # so later windows belong to the decima benches and flagship
     # training — one more stage-3 pass re-measures under the widened
-    # be∈{4,8,16} calibration, then the marker stops repeats)
+    # be∈{4,8,16} calibration, then the marker stops repeats; the
+    # marker is deleted at watcher launch, so "lifetime" really means
+    # this watcher process, not until-reboot)
     HEADLINE_MARK=/tmp/headline_r05_remeasured
     if [ ! -f "$HEADLINE_MARK" ]; then
       timeout -k 60 3600 python scripts_chip_session.py 1 3 \
@@ -114,6 +120,12 @@ print('ALIVE')
     # one dead compile no longer forfeits the stage).
     timeout -k 60 2700 python scripts_chip_session.py 4
     echo "decima-bench rc=$? at $(date +%H:%M:%S)"
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # round-6: decima_flat rows (flat-engine rollout collection — the
+    # training fast path this round routed Decima through). Separate
+    # stage so a truncated stage-4 window doesn't forfeit these rows.
+    timeout -k 60 2700 python scripts_chip_session.py 8
+    echo "decima-flat-bench rc=$? at $(date +%H:%M:%S)"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable
     # sessions (state saved every session; a wedge mid-session loses at

@@ -1518,8 +1518,17 @@ def _resume_simulation(
 
 def _compute_jobtime(
     params: EnvParams, state: EnvState, t_old: jnp.ndarray,
-    active_old: jnp.ndarray
+    active_old: jnp.ndarray, t_ref: jnp.ndarray | None = None
 ) -> jnp.ndarray:
+    """Total (optionally beta-discounted) job-time over [t_old, wall_time].
+
+    `t_ref` is the discount reference point; it defaults to `t_old` (the
+    per-decision-step form `step` uses). The flat engine's trajectory
+    recording accumulates job-time one micro-step at a time and passes the
+    wall time of the round-finishing decision as `t_ref`, so the partial
+    contributions telescope to exactly the single-span quantity `step`
+    would have computed (exp(-b(x - t_ref)) factors cancel at interior
+    interval boundaries; for beta == 0 the sum is plainly additive)."""
     t_new = state.wall_time
     m = active_old | state.job_active
     start = jnp.maximum(state.job_arrival_time, t_old)
@@ -1527,8 +1536,9 @@ def _compute_jobtime(
     if params.beta == 0.0:
         per = end - start
     else:
+        ref = t_old if t_ref is None else t_ref
         b = params.beta * 1e-3
-        per = jnp.exp(-b * (start - t_old)) - jnp.exp(-b * (end - t_old))
+        per = jnp.exp(-b * (start - ref)) - jnp.exp(-b * (end - ref))
     total = jnp.where(m, per, 0.0).sum()
     if params.beta > 0.0:
         total = total / params.beta

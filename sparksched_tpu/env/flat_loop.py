@@ -16,13 +16,17 @@ lane ever idles waiting for a straggler. Semantics are identical to the
 step equivalence is asserted by tests/test_flat_loop.py.
 
 Used by bench/eval paths where only final states and decision counts
-matter; trainers keep the per-decision scan (they must record per-decision
-buffers at fixed offsets).
+matter, and — since round 6 — by the trainers' fast rollout collectors
+(`trainers/rollout.py:collect_flat_sync/_async`): with `record=True` a
+micro-step additionally reports the DECIDE branch's observation/action/
+log-prob plus the micro-step's reward and wall-clock advance, which the
+collectors scatter into fixed-offset per-decision buffers (the DECIDE
+mask keeps non-decision micro-steps out of the PPO batch).
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +37,7 @@ from ..config import EnvParams
 from ..workload.bank import WorkloadBank
 from .core import (
     RQ_NONE,
+    _compute_jobtime,
     _rank_order,
     _onehot2,
     _add_commitment,
@@ -68,6 +73,44 @@ class LoopState(struct.PyTreeNode):
     decisions: jnp.ndarray  # i32 []; decision micro-steps taken
     episodes: jnp.ndarray  # i32 []; completed episodes
     bulked: jnp.ndarray  # i32 []; events consumed by bulk relaunches
+
+
+def aux_action_fields(aux: dict, stage_idx: jnp.ndarray,
+                      num_exec: jnp.ndarray, max_stages: int):
+    """(lgprob, job_idx, num_exec_k) from a policy's aux dict, with the
+    derivation fallbacks for policies that omit keys (heuristics report
+    no job_idx; it derives from the flat padded node index
+    stage_idx = job * max_stages + stage). Single source of truth for
+    BOTH collection paths — `trainers/rollout.py` (core.step scan) and
+    `micro_step(record=True)` below — so their recorded actions cannot
+    drift apart."""
+    lgprob = aux.get("lgprob", jnp.float32(0.0))
+    job = aux.get(
+        "job_idx", jnp.where(stage_idx >= 0, stage_idx // max_stages, 0)
+    )
+    k = aux.get("num_exec_k", num_exec - 1)
+    return lgprob, job, k
+
+
+class MicroRec(struct.PyTreeNode):
+    """One micro-step's trajectory record (`micro_step(record=True)`).
+
+    `obs` and the action fields are meaningful only where `decide` is set
+    (the micro-step ran the DECIDE branch on a live lane); `reward` is the
+    micro-step's negative job-time contribution (discount-referenced to
+    the caller-carried `t_ref`, see `_compute_jobtime`), `dt` its
+    wall-clock advance (pre-reset), and `reset` whether the episode ended
+    during the micro-step."""
+
+    obs: Any  # Observation at the micro-step's start
+    stage_idx: jnp.ndarray  # i32 []; raw policy output
+    job_idx: jnp.ndarray  # i32 []
+    num_exec_k: jnp.ndarray  # i32 []; 0-based exec choice
+    lgprob: jnp.ndarray  # f32 []
+    decide: jnp.ndarray  # bool []
+    reward: jnp.ndarray  # f32 []
+    dt: jnp.ndarray  # f32 []
+    reset: jnp.ndarray  # bool []
 
 
 def init_loop_state(state: EnvState) -> LoopState:
@@ -187,7 +230,10 @@ def micro_step(
     bulk_events: int = 8,
     fulfill_bulk: bool = False,
     bulk_cycles: int = 1,
-) -> LoopState:
+    record: bool = False,
+    reset_fn: Callable | None = None,
+    t_ref: jnp.ndarray | None = None,
+) -> LoopState | tuple[LoopState, MicroRec]:
     """One unit of work for one lane (vmap over lanes). With
     `event_bulk`, an EVENT micro-step consumes a whole run of relaunch
     events via `core._bulk_relaunch` (hoisted above the mode switch —
@@ -213,7 +259,19 @@ def micro_step(
     the relaunch cascade, the pass's op count is charged to every lane
     on every micro-step under vmap (a batched `lax.switch` executes all
     branches), so the flag is calibration-gated in bench.py rather than
-    assumed to win."""
+    assumed to win.
+
+    With `record` (static), returns `(LoopState, MicroRec)` instead of
+    just the state: the DECIDE branch's observation/policy outputs are
+    hoisted above the mode switch (identical cost under vmap, where a
+    batched switch executes every branch anyway) so the trainers' flat
+    collectors can scatter them into per-decision buffers. `reset_fn`,
+    when given, replaces the auto-reset draw: called as
+    `reset_fn(key, episodes)` with the lane's completed-episode count,
+    which the async collector maps to the group-shared reset ordinal.
+    `t_ref` is the discount reference wall time for the recorded reward
+    (the wall time of the round-finishing decision; only read when
+    `params.beta > 0`)."""
     k_pol, k_reset = jax.random.split(rng)
     ls0 = ls  # pre-bulk state: the freeze path must restore exactly this
     if event_bulk:
@@ -228,10 +286,23 @@ def micro_step(
     n = st.exec_job.shape[0]
     s_cap = params.max_stages
 
+    if record:
+        # bulk passes never touch DECIDE-mode lanes, so the post-bulk env
+        # equals the pre-bulk env wherever the decide branch runs and the
+        # hoisted observation is exactly what the branch would compute
+        r_obs = observe(params, st, compute_levels)
+        r_stage, r_nexec, r_aux = policy_fn(k_pol, r_obs)
+        r_lgprob, r_job, r_k = aux_action_fields(
+            r_aux, r_stage, r_nexec, s_cap
+        )
+
     # ---- DECIDE: one commitment from the policy (core.step's front half)
     def decide(ls: LoopState):
-        obs = observe(params, ls.env, compute_levels)
-        stage_idx, num_exec, _ = policy_fn(k_pol, obs)
+        if record:
+            obs, stage_idx, num_exec = r_obs, r_stage, r_nexec
+        else:
+            obs = observe(params, ls.env, compute_levels)
+            stage_idx, num_exec, _ = policy_fn(k_pol, obs)
         st = ls.env
         j, s = stage_idx // s_cap, stage_idx % s_cap
         valid = (
@@ -353,10 +424,32 @@ def micro_step(
     ls2, rk, rj, rs, e, quirk = lax.switch(
         ls.mode, [decide, fulfill, event], ls
     )
-    return _finish_micro_step(
+    out = _finish_micro_step(
         params, bank, ls0, ls2, rk, rj, rs, e, quirk, k_reset, auto_reset,
-        fulfill_bulk=fulfill_bulk,
+        fulfill_bulk=fulfill_bulk, record=record, reset_fn=reset_fn,
+        t_ref=t_ref,
     )
+    if not record:
+        return out
+    ls_f, (r_reward, r_dt, r_reset) = out
+    # frozen lanes (auto_reset=False, episode already over at entry) must
+    # not report a decision — the tail rolls their state/counters back
+    was_done = (
+        ls0.env.all_jobs_complete
+        | (ls0.env.wall_time >= ls0.env.time_limit)
+    )
+    rec = MicroRec(
+        obs=r_obs,
+        stage_idx=r_stage,
+        job_idx=r_job,
+        num_exec_k=r_k,
+        lgprob=r_lgprob,
+        decide=(ls0.mode == M_DECIDE) & ~was_done,
+        reward=r_reward,
+        dt=r_dt,
+        reset=r_reset,
+    )
+    return ls_f, rec
 
 
 def _finish_micro_step(
@@ -372,10 +465,15 @@ def _finish_micro_step(
     k_reset: jax.Array,
     auto_reset: bool,
     fulfill_bulk: bool = False,
-) -> LoopState:
+    record: bool = False,
+    reset_fn: Callable | None = None,
+    t_ref: jnp.ndarray | None = None,
+) -> LoopState | tuple[LoopState, tuple]:
     """Shared micro-step tail: move resolution/application, round clearing
     and readiness, episode end. `ls` is the pre-step state, `ls2` the
-    state after the mode branch ran.
+    state after the mode branch ran. With `record`, also returns the
+    micro-step's `(reward, dt, reset)` triple, measured on the pre-reset
+    state and zeroed for frozen lanes (see `MicroRec`).
 
     With `fulfill_bulk`, a DECIDE micro-step that just finished a
     commitment round (mode went DECIDE -> FULFILL) consumes the
@@ -454,10 +552,27 @@ def _finish_micro_step(
         ls.env.all_jobs_complete
         | (ls.env.wall_time >= ls.env.time_limit)
     )
+    if record:
+        # reward/dt on the PRE-reset state (the reset select below would
+        # lose the episode's final span); frozen lanes report zeros
+        t_old = ls.env.wall_time
+        jt = _compute_jobtime(
+            params, st, t_old, ls.env.job_active, t_ref
+        )
+        rec_tail = (
+            jnp.where(was_done, 0.0, -jt),
+            jnp.where(was_done, 0.0, st.wall_time - t_old),
+            done & ~was_done,
+        )
     if auto_reset:
         from . import core as _core
 
-        fresh = _core.reset(params, bank, k_reset)
+        if reset_fn is None:
+            fresh = _core.reset(params, bank, k_reset)
+        else:
+            # ls2.episodes is the pre-increment completed-episode count:
+            # the async collector's group-shared reset-ordinal hook
+            fresh = reset_fn(k_reset, ls2.episodes)
         st = jax.tree_util.tree_map(
             lambda a, b: jnp.where(done, a, b), fresh, st
         )
@@ -474,11 +589,12 @@ def _finish_micro_step(
                 was_done, ls.bulked, ls2.bulked
             ).astype(_i32),
         )
-    return ls2.replace(
+    out = ls2.replace(
         env=st,
         mode=mode,
         episodes=ls2.episodes + (done & ~was_done).astype(_i32),
     )
+    return (out, rec_tail) if record else out
 
 
 def event_micro_step(
@@ -490,9 +606,14 @@ def event_micro_step(
     event_bulk: bool = True,
     bulk_events: int = 8,
     bulk_cycles: int = 1,
-) -> LoopState:
+    record: bool = False,
+    reset_fn: Callable | None = None,
+    t_ref: jnp.ndarray | None = None,
+) -> LoopState | tuple[LoopState, tuple]:
     """One EVENT-only micro-step: lanes in M_EVENT mode pop + handle one
-    event (with the full shared tail); other lanes no-op.
+    event (with the full shared tail); other lanes no-op. With `record`,
+    also returns the `(reward, dt, reset)` triple (zeroed for non-event
+    lanes, which are untouched).
 
     The point is cost amortization under vmap: a full `micro_step` pays
     for all three mode branches on every lane (batched `lax.switch`
@@ -519,11 +640,22 @@ def event_micro_step(
     out = _finish_micro_step(
         params, bank, ls0, ls_ev,
         rk, rj, rs, arg, quirk, k_reset, auto_reset,
+        record=record, reset_fn=reset_fn, t_ref=t_ref,
     )
+    if record:
+        out, (rw, dt, rs_) = out
     # non-event lanes are untouched (their rng/state must not advance)
-    return jax.tree_util.tree_map(
+    final = jax.tree_util.tree_map(
         lambda a, b: jnp.where(is_event, a, b), out, ls
     )
+    if record:
+        zero = jnp.float32(0.0)
+        return final, (
+            jnp.where(is_event, rw, zero),
+            jnp.where(is_event, dt, zero),
+            is_event & rs_,
+        )
+    return final
 
 
 def run_flat(

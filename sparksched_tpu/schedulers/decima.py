@@ -327,35 +327,40 @@ def sample_action(
     stage_scores: jnp.ndarray,
     exec_scores: jnp.ndarray,
     f: DecimaFeatures,
+    deterministic: bool = False,
 ):
     """Autoregressive sample: stage via masked softmax over all schedulable
     nodes, then executor count conditioned on the stage's job (reference
-    scheduler.py:81-99). Returns (DecimaAction, lgprob)."""
+    scheduler.py:81-99). Returns (DecimaAction, lgprob). With
+    `deterministic` (static), both heads take the masked argmax instead of
+    sampling (greedy eval / rng-free parity testing); `lgprob` is still the
+    softmax log-probability of the chosen action."""
     j_cap, s_cap = f.stage_mask.shape
     k_stage, k_exec = jax.random.split(rng)
 
     flat_mask = f.stage_mask.reshape(-1)
     logp_stage = masked_log_softmax(stage_scores.reshape(-1), flat_mask)
     valid = flat_mask.any()
-    stage_flat = jnp.where(
-        valid,
-        jax.random.categorical(
-            k_stage, jnp.where(flat_mask, stage_scores.reshape(-1), NEG_INF)
-        ),
-        -1,
-    ).astype(_i32)
+    stage_logits = jnp.where(flat_mask, stage_scores.reshape(-1), NEG_INF)
+    stage_pick = (
+        jnp.argmax(stage_logits)
+        if deterministic
+        else jax.random.categorical(k_stage, stage_logits)
+    )
+    stage_flat = jnp.where(valid, stage_pick, -1).astype(_i32)
     job = jnp.where(valid, stage_flat // s_cap, -1).astype(_i32)
 
     e_mask = f.exec_mask[jnp.maximum(job, 0)]
     logp_exec = masked_log_softmax(exec_scores[jnp.maximum(job, 0)], e_mask)
-    k = jnp.where(
-        e_mask.any(),
-        jax.random.categorical(
-            k_exec,
-            jnp.where(e_mask, exec_scores[jnp.maximum(job, 0)], NEG_INF),
-        ),
-        0,
-    ).astype(_i32)
+    exec_logits = jnp.where(
+        e_mask, exec_scores[jnp.maximum(job, 0)], NEG_INF
+    )
+    exec_pick = (
+        jnp.argmax(exec_logits)
+        if deterministic
+        else jax.random.categorical(k_exec, exec_logits)
+    )
+    k = jnp.where(e_mask.any(), exec_pick, 0).astype(_i32)
 
     lgprob = jnp.where(
         valid,
@@ -469,17 +474,36 @@ class DecimaScheduler(TrainableScheduler):
         )
 
     # -- pure policy (vmap/scan-safe) -------------------------------------
-    def policy(self, rng: jax.Array, obs: Observation, params=None):
+    def policy(self, rng: jax.Array, obs: Observation, params=None,
+               deterministic: bool = False):
         params = self.params if params is None else params
         f = self.features(obs)
         stage_scores, exec_scores = self.net.apply(params, f)
-        action, lgprob = sample_action(rng, stage_scores, exec_scores, f)
+        action, lgprob = sample_action(
+            rng, stage_scores, exec_scores, f, deterministic
+        )
         # env takes a 1-based executor count (reference env_wrapper.py:33-34)
         return action.stage_idx, action.num_exec + 1, {
             "lgprob": lgprob,
             "job_idx": action.job_idx,
             "num_exec_k": action.num_exec,
         }
+
+    # -- flat micro-step engine adapter ------------------------------------
+    def flat_policy(self, params=None, deterministic: bool = False):
+        """Bind this scheduler into a `policy_fn(rng, obs)` for the flat
+        micro-step engine (`env/flat_loop.py`): the dense per-job einsum
+        GNN runs on the DECIDE branch's padded observation inside the
+        micro-step scan, and the aux dict carries the log-prob/action
+        decomposition the trajectory recorder stores. Pass explicit
+        `params` (e.g. the live training parameters) to keep the returned
+        closure jit/scan-safe across parameter updates."""
+        p = self.params if params is None else params
+
+        def policy_fn(rng, obs):
+            return self.policy(rng, obs, p, deterministic)
+
+        return policy_fn
 
     # -- host-side single decision ----------------------------------------
     def schedule(self, obs: Observation):

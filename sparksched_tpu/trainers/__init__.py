@@ -12,6 +12,8 @@ from .rollout import (  # noqa: F401
     Rollout,
     StoredObs,
     collect_async,
+    collect_flat_async,
+    collect_flat_sync,
     collect_sync,
     store_obs,
     stored_to_observation,

@@ -28,6 +28,14 @@ from jax import lax
 
 from ..config import EnvParams
 from ..env import core
+from ..env.flat_loop import (
+    M_DECIDE,
+    LoopState,
+    aux_action_fields,
+    event_micro_step,
+    init_loop_state,
+    micro_step,
+)
 from ..env.observe import Observation, observe
 from ..env.state import EnvState
 from ..workload.bank import WorkloadBank
@@ -135,14 +143,10 @@ PolicyFn = Callable[[jax.Array, Observation], tuple]
 
 def _aux_fields(aux: dict, stage_idx: jnp.ndarray, num_exec: jnp.ndarray,
                 max_stages: int):
-    lgprob = aux.get("lgprob", jnp.float32(0.0))
-    # heuristic policies don't report job_idx; derive it from the flat
-    # padded node index (stage_idx = job * max_stages + stage)
-    job = aux.get(
-        "job_idx", jnp.where(stage_idx >= 0, stage_idx // max_stages, 0)
-    )
-    k = aux.get("num_exec_k", num_exec - 1)
-    return lgprob, job, k
+    # single source of truth shared with the flat engine's record path
+    # (env/flat_loop.py:aux_action_fields) so the two collection paths'
+    # recorded actions cannot drift apart
+    return aux_action_fields(aux, stage_idx, num_exec, max_stages)
 
 
 @partial(jax.jit, static_argnums=(0, 2, 4))
@@ -311,3 +315,317 @@ def vmap_collect(collect_fn, params, bank, policy_fn, rngs, num_steps,
             params, bank, policy_fn, r, num_steps, s, *args
         )
     )(rngs, states)
+
+
+# ---------------------------------------------------------------------------
+# flat micro-step collection (env/flat_loop.py engine)
+#
+# The per-decision `core.step` scan above pays the straggler tax of a
+# vmapped `lax.while_loop` between decisions (batch-max event count per
+# decision, measured ~6x the mean at 64 lanes). The collectors below drive
+# the flat micro-step engine instead — every lane advances by one unit of
+# work per iteration — and scatter the DECIDE micro-steps' records into
+# the same fixed-shape `Rollout` the trainers already consume, so only
+# decision steps enter the PPO batch. Collected quantities are step-exact
+# vs the `core.step` path (tests/test_flat_loop.py parity test): actions,
+# log-probs, the DECIDE mask, per-decision wall times and rewards (the
+# micro-step reward deltas telescope to `core.step`'s per-decision span
+# quantity — see `core._compute_jobtime`'s `t_ref` note).
+# ---------------------------------------------------------------------------
+
+
+def flat_micro_group_budget(
+    num_steps: int, micro_per_decision: float, event_burst: int
+) -> int:
+    """Scan length (micro-step groups) for the flat collectors:
+    ceil(num_steps * micro_per_decision / event_burst). Shared by the
+    trainer and bench_decima so the two cannot drift on rounding."""
+    import math
+
+    return max(
+        1, math.ceil(num_steps * micro_per_decision / event_burst)
+    )
+
+
+def _zero_stored(params: EnvParams) -> StoredObs:
+    j, s = params.max_jobs, params.max_stages
+    f32 = jnp.float32
+    return StoredObs(
+        remaining=jnp.zeros((j, s), _i32),
+        duration=jnp.zeros((j, s), f32),
+        schedulable=jnp.zeros((j, s), bool),
+        node_mask=jnp.zeros((j, s), bool),
+        job_mask=jnp.zeros((j,), bool),
+        job_template=jnp.zeros((j,), _i32),
+        exec_supplies=jnp.zeros((j,), _i32),
+        num_committable=_i32(0),
+        source_job=_i32(-1),
+    )
+
+
+class _FlatBuf(struct.PyTreeNode):
+    """Fixed-offset per-decision buffers the micro-step scan scatters
+    into (carried through the scan — per-micro-step stacking would
+    multiply rollout memory by the micro-steps-per-decision factor)."""
+
+    obs: StoredObs  # [T, ...]
+    stage_idx: jnp.ndarray  # i32[T]
+    job_idx: jnp.ndarray  # i32[T]
+    num_exec_k: jnp.ndarray  # i32[T]
+    lgprob: jnp.ndarray  # f32[T]
+    reward: jnp.ndarray  # f32[T]
+    walls: jnp.ndarray  # f32[T]
+    resets: jnp.ndarray  # i32[T]
+
+
+def _flat_collect(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: PolicyFn,
+    rng: jax.Array,
+    num_steps: int,
+    ls: LoopState,
+    micro_groups: int,
+    auto_reset: bool,
+    event_burst: int,
+    event_bulk: bool,
+    bulk_events: int,
+    fulfill_bulk: bool,
+    bulk_cycles: int,
+    reset_fn,
+    rollout_duration,
+    use_elapsed: bool,
+):
+    """Shared flat-engine collection scan for one lane (vmap over lanes).
+
+    Scans `micro_groups` micro-step groups (one full micro-step plus
+    `event_burst - 1` event-only sub-steps, the `run_flat` grouping).
+    Each group's DECIDE record lands in per-decision slot `ndec` and its
+    micro-rewards/resets accumulate into the slot of the most recent
+    decision, so decision k's reward is exactly the job-time of the span
+    (decision k, decision k+1]. A lane freezes when its decision buffer
+    is full AND it is about to decide again (so the last slot still
+    receives its full trailing span, matching `collect_sync`'s T-step
+    truncation), or — async — when `rollout_duration` sim-time elapsed.
+    Micro-rewards before a chunk's first decision (async lanes resuming
+    mid-phase) belong to the previous chunk's final decision, which was
+    already consumed; they are dropped together with their `dt`, which
+    keeps the (reward, dt) pairing the returns/average-job estimators
+    rely on consistent."""
+    T = num_steps
+    zs = _zero_stored(params)
+    buf0 = _FlatBuf(
+        obs=jax.tree_util.tree_map(
+            lambda a: jnp.zeros((T,) + a.shape, a.dtype), zs
+        ),
+        stage_idx=jnp.zeros(T, _i32),
+        job_idx=jnp.zeros(T, _i32),
+        num_exec_k=jnp.zeros(T, _i32),
+        lgprob=jnp.zeros(T, jnp.float32),
+        reward=jnp.zeros(T, jnp.float32),
+        walls=jnp.zeros(T, jnp.float32),
+        resets=jnp.zeros(T, _i32),
+    )
+
+    def body(carry, _):
+        ls, k, t_ref, elapsed, ndec, buf = carry
+        k, sub = jax.random.split(k)
+        env0 = ls.env
+        wall0 = env0.wall_time
+        # pre-step freeze: full decision buffer about to decide again,
+        # or (async) sim-time budget exhausted
+        over = (ls.mode == M_DECIDE) & (ndec >= T)
+        if rollout_duration is not None:
+            over = over | (elapsed >= rollout_duration)
+
+        ls2, rec = micro_step(
+            params, bank, policy_fn, ls, sub, auto_reset, True,
+            event_bulk, bulk_events, fulfill_bulk, bulk_cycles,
+            record=True, reset_fn=reset_fn, t_ref=t_ref,
+        )
+        # advance the discount reference BEFORE the burst sub-steps: with
+        # fulfill_bulk a round-finishing DECIDE micro-step jumps straight
+        # to M_EVENT, so this group's own sub-steps already advance time
+        # within the NEW decision's span
+        t_ref = jnp.where(rec.decide & ~over, wall0, t_ref)
+        reward, dt, reset = rec.reward, rec.dt, rec.reset
+        for _ in range(event_burst - 1):
+            k, sub = jax.random.split(k)
+            ls2, (rw, dd, rr) = event_micro_step(
+                params, bank, ls2, sub, auto_reset, event_bulk,
+                bulk_events, bulk_cycles,
+                record=True, reset_fn=reset_fn, t_ref=t_ref,
+            )
+            reward = reward + rw
+            dt = dt + dd
+            reset = reset | rr
+
+        # frozen lanes: state untouched, nothing recorded
+        ls2 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(over, a, b), ls, ls2
+        )
+        zero = jnp.float32(0.0)
+        reward = jnp.where(over, zero, reward)
+        dt = jnp.where(over, zero, dt)
+        reset = reset & ~over
+        dec = rec.decide & ~over
+
+        # decision-slot scatter (mode="drop" discards non-decide steps
+        # and buffer overflow alike)
+        slot = jnp.where(dec & (ndec < T), ndec, T)
+        stored = store_obs(rec.obs, env0)
+        buf = buf.replace(
+            obs=jax.tree_util.tree_map(
+                lambda b, v: b.at[slot].set(v, mode="drop"),
+                buf.obs, stored,
+            ),
+            stage_idx=buf.stage_idx.at[slot].set(
+                rec.stage_idx, mode="drop"
+            ),
+            job_idx=buf.job_idx.at[slot].set(rec.job_idx, mode="drop"),
+            num_exec_k=buf.num_exec_k.at[slot].set(
+                rec.num_exec_k, mode="drop"
+            ),
+            lgprob=buf.lgprob.at[slot].set(rec.lgprob, mode="drop"),
+            walls=buf.walls.at[slot].set(
+                elapsed if use_elapsed else wall0, mode="drop"
+            ),
+        )
+        ndec2 = ndec + dec.astype(_i32)
+        # micro-rewards belong to the most recent decision's span
+        rslot = jnp.where((ndec2 > 0) & (ndec2 <= T), ndec2 - 1, T)
+        buf = buf.replace(
+            reward=buf.reward.at[rslot].add(reward, mode="drop"),
+            resets=buf.resets.at[rslot].max(
+                reset.astype(_i32), mode="drop"
+            ),
+        )
+        return (ls2, k, t_ref, elapsed + dt, ndec2, buf), None
+
+    carry0 = (
+        ls, rng, ls.env.wall_time, jnp.float32(0.0), _i32(0), buf0
+    )
+    (ls, _, _, elapsed, ndec, buf), _ = lax.scan(
+        body, carry0, None, length=micro_groups
+    )
+
+    valid = jnp.arange(T) < jnp.minimum(ndec, T)
+    final_t = elapsed if use_elapsed else ls.env.wall_time
+    walls = jnp.where(valid, buf.walls, final_t)
+    ro = Rollout(
+        obs=buf.obs,
+        stage_idx=jnp.where(valid, buf.stage_idx, -1),
+        job_idx=buf.job_idx,
+        num_exec_k=buf.num_exec_k,
+        lgprob=buf.lgprob,
+        reward=buf.reward,
+        wall_times=jnp.concatenate([walls, final_t[None]]),
+        valid=valid,
+        resets=buf.resets > 0,
+        final_state=ls.env,
+        final_reset_count=ls.episodes,
+    )
+    return ro, ls
+
+
+@partial(
+    jax.jit, static_argnums=(0, 2, 4),
+    static_argnames=(
+        "micro_groups", "event_burst", "event_bulk", "bulk_events",
+        "fulfill_bulk", "bulk_cycles",
+    ),
+)
+def collect_flat_sync(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: PolicyFn,
+    rng: jax.Array,
+    num_steps: int,
+    state: EnvState,
+    *,
+    micro_groups: int,
+    event_burst: int = 1,
+    event_bulk: bool = True,
+    bulk_events: int = 8,
+    fulfill_bulk: bool = False,
+    bulk_cycles: int = 1,
+) -> Rollout:
+    """Flat-engine equivalent of `collect_sync`: one episode from the
+    given freshly-reset state, micro-stepped with frozen lanes at episode
+    end, padded to `num_steps` decisions. `micro_groups` bounds the scan
+    (size it at ~3-4 micro-step groups per expected decision; a too-small
+    value truncates the episode exactly like a too-small `num_steps`)."""
+    ro, _ = _flat_collect(
+        params, bank, policy_fn, rng, num_steps,
+        init_loop_state(state), micro_groups,
+        auto_reset=False, event_burst=event_burst, event_bulk=event_bulk,
+        bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
+        bulk_cycles=bulk_cycles, reset_fn=None, rollout_duration=None,
+        use_elapsed=False,
+    )
+    return ro
+
+
+@partial(
+    jax.jit, static_argnums=(0, 2, 4),
+    static_argnames=(
+        "micro_groups", "event_burst", "event_bulk", "bulk_events",
+        "fulfill_bulk", "bulk_cycles",
+    ),
+)
+def collect_flat_async(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: PolicyFn,
+    rng: jax.Array,
+    num_steps: int,
+    loop_state: LoopState,
+    rollout_duration: jnp.ndarray | float = jnp.inf,
+    seq_base: jax.Array | None = None,
+    lane_salt: jnp.ndarray | int = 0,
+    reset_count: jnp.ndarray | int = 0,
+    *,
+    micro_groups: int,
+    event_burst: int = 1,
+    event_bulk: bool = True,
+    bulk_events: int = 8,
+    fulfill_bulk: bool = False,
+    bulk_cycles: int = 1,
+) -> tuple[Rollout, LoopState]:
+    """Flat-engine equivalent of `collect_async`: persistent lanes with a
+    fixed sim-time budget per iteration and mid-scan auto-resets drawn
+    from `fold_in(seq_base, reset_count + completed_episodes)` — the same
+    group-shared job-sequence scheme as `collect_async` (lanes sharing
+    `seq_base` replay identical sequences at equal reset ordinals).
+
+    Takes and returns the full `LoopState` (a budget-frozen lane may be
+    mid-FULFILL/EVENT phase, which `EnvState` alone cannot represent);
+    the returned rollout's `final_reset_count` is the next reset ordinal,
+    as in `collect_async`. The budget check runs at micro-step-group
+    granularity rather than `collect_async`'s decision granularity, and
+    micro-rewards a resumed lane accrues before its first decision of the
+    chunk are dropped (see `_flat_collect`)."""
+    rollout_duration = jnp.float32(rollout_duration)
+    if seq_base is None:
+        seq_base = rng
+    lane_salt = jnp.asarray(lane_salt, _i32)
+    reset_count = jnp.asarray(reset_count, _i32)
+    # episodes doubles as the chunk's reset ordinal offset; zero it so
+    # `reset_count + episodes` counts from this chunk's start
+    loop_state = loop_state.replace(episodes=jnp.zeros((), _i32))
+
+    def reset_fn(key, episodes):
+        seq_rng = jax.random.fold_in(seq_base, reset_count + episodes)
+        return core.reset_pair(
+            params, bank, seq_rng, jax.random.fold_in(seq_rng, lane_salt)
+        )
+
+    ro, ls = _flat_collect(
+        params, bank, policy_fn, rng, num_steps, loop_state, micro_groups,
+        auto_reset=True, event_burst=event_burst, event_bulk=event_bulk,
+        bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
+        bulk_cycles=bulk_cycles, reset_fn=reset_fn,
+        rollout_duration=rollout_duration, use_elapsed=True,
+    )
+    ro = ro.replace(final_reset_count=reset_count + ls.episodes)
+    return ro, ls

@@ -14,6 +14,14 @@ reward_buff_cap, rollout_duration -> async mode, opt_kwargs,
 max_grad_norm, + PPO keys), `agent:`, `env:`. One new required cap:
 `rollout_steps` — the static scan length (the reference's dynamic episode
 lengths become masked fixed-shape rollouts).
+
+Rollout-engine keys (all optional): `rollout_engine: core|flat` selects
+the per-decision `core.step` scan or the flat micro-step engine
+(env/flat_loop.py; see trainers/rollout.py:collect_flat_*), and
+`flat_micro_per_decision` / `flat_event_burst` / `flat_event_bulk` /
+`flat_bulk_events` / `flat_fulfill_bulk` / `flat_bulk_cycles` expose the
+flat engine's calibration surface (bench.py documents the per-backend
+winners).
 """
 
 from __future__ import annotations
@@ -45,7 +53,15 @@ from .returns import (
     discounted_returns,
     step_dts,
 )
-from .rollout import Rollout, collect_async, collect_sync
+from ..env.flat_loop import init_loop_state
+from .rollout import (
+    Rollout,
+    collect_async,
+    collect_flat_async,
+    collect_flat_sync,
+    collect_sync,
+    flat_micro_group_budget,
+)
 
 CfgType = dict[str, Any]
 
@@ -184,6 +200,40 @@ class Trainer(abc.ABC):
             "rollout_steps", 48 * self.params_env.max_jobs
         )
 
+        # rollout engine: "core" drives the per-decision core.step scan
+        # (a vmapped while_loop between decisions — pays the batch-max
+        # straggler tax); "flat" drives the flat micro-step engine
+        # (env/flat_loop.py) and scatters DECIDE micro-steps into the
+        # same Rollout (trainers/rollout.py:collect_flat_*). Knobs
+        # mirror bench.py's calibration surface.
+        self.rollout_engine: str = str(
+            train_cfg.get("rollout_engine", "core")
+        )
+        if self.rollout_engine not in ("core", "flat"):
+            raise ValueError(
+                f"rollout_engine must be 'core' or 'flat', got "
+                f"{self.rollout_engine!r}"
+            )
+        # micro-step-group budget per decision: the scan runs
+        # rollout_steps * this many groups (PERF.md mode census: ~3
+        # micro-steps per decision in steady state; 4 adds headroom)
+        self.flat_micro_per_decision: float = float(
+            train_cfg.get("flat_micro_per_decision", 4.0)
+        )
+        self.flat_knobs = {
+            "event_burst": int(train_cfg.get("flat_event_burst", 1)),
+            "event_bulk": bool(train_cfg.get("flat_event_bulk", True)),
+            "bulk_events": int(train_cfg.get("flat_bulk_events", 8)),
+            "fulfill_bulk": bool(
+                train_cfg.get("flat_fulfill_bulk", False)
+            ),
+            "bulk_cycles": int(train_cfg.get("flat_bulk_cycles", 1)),
+        }
+        self.flat_micro_groups: int = flat_micro_group_budget(
+            self.rollout_steps, self.flat_micro_per_decision,
+            self.flat_knobs["event_burst"],
+        )
+
         # bound the Decima level scan by the bank's true max DAG depth
         # (bit-identical — deeper levels are no-op updates — and the
         # dominant GNN cost scales with it; the synthetic bank is 6 deep
@@ -288,11 +338,14 @@ class Trainer(abc.ABC):
         def policy_fn(k, obs):
             return self.scheduler.policy(k, obs, model_params)
 
+        flat = self.rollout_engine == "flat"
         if self.rollout_duration:  # async mode
             if env_states is None:
                 states = jax.vmap(
                     lambda s, l: core.reset_pair(p, bank, s, l)
                 )(seq_rngs, lane_rngs)
+                if flat:
+                    states = jax.vmap(init_loop_state)(states)
                 # the initial reset consumed ordinal `iteration`; the
                 # next (mid-scan) reset of any lane is ordinal + 1
                 reset_counts = jnp.full(
@@ -304,6 +357,16 @@ class Trainer(abc.ABC):
                 lambda g: jax.random.fold_in(master, g)
             )(g_ids)
             lane_salts = (1000 + r_ids).astype(jnp.int32)
+            if flat:
+                ro, loop_states = jax.vmap(
+                    lambda k, s, sb, salt, rc: collect_flat_async(
+                        p, bank, policy_fn, k, self.rollout_steps, s,
+                        self.rollout_duration, sb, salt, rc,
+                        micro_groups=self.flat_micro_groups,
+                        **self.flat_knobs,
+                    )
+                )(pol_rngs, states, seq_bases, lane_salts, reset_counts)
+                return ro, (loop_states, ro.final_reset_count)
             ro = jax.vmap(
                 lambda k, s, sb, salt, rc: collect_async(
                     p, bank, policy_fn, k, self.rollout_steps, s,
@@ -315,11 +378,20 @@ class Trainer(abc.ABC):
             states = jax.vmap(
                 lambda s, l: core.reset_pair(p, bank, s, l)
             )(seq_rngs, lane_rngs)
-            ro = jax.vmap(
-                lambda k, s: collect_sync(
-                    p, bank, policy_fn, k, self.rollout_steps, s
-                )
-            )(pol_rngs, states)
+            if flat:
+                ro = jax.vmap(
+                    lambda k, s: collect_flat_sync(
+                        p, bank, policy_fn, k, self.rollout_steps, s,
+                        micro_groups=self.flat_micro_groups,
+                        **self.flat_knobs,
+                    )
+                )(pol_rngs, states)
+            else:
+                ro = jax.vmap(
+                    lambda k, s: collect_sync(
+                        p, bank, policy_fn, k, self.rollout_steps, s
+                    )
+                )(pol_rngs, states)
             return ro, None
 
     def _returns_and_baselines(self, state: TrainState, ro: Rollout):

@@ -315,6 +315,114 @@ def test_run_flat_loop_state_resume_matches_single_run():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_flat_decima_collection_matches_core_step_path(monkeypatch):
+    """The tentpole guarantee of the flat rollout collectors: a Decima
+    rollout collected from the flat micro-step engine
+    (`collect_flat_sync`) must agree step-exactly with the per-decision
+    `core.step` collection path (`collect_sync`) at fixed seeds —
+    actions (stage/job/exec choice), log-probs, per-decision rewards,
+    wall times, the DECIDE/valid mask, and the stored observations the
+    PPO update rebuilds features from. The duration sampler is pinned
+    deterministic (the engines' rng STREAMS legitimately differ) and the
+    policy is greedy Decima (argmax heads), so every compared quantity
+    is rng-independent."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.schedulers import DecimaScheduler
+    from sparksched_tpu.trainers.rollout import (
+        collect_flat_sync,
+        collect_sync,
+    )
+    from sparksched_tpu.workload import make_workload_bank
+
+    def det_sampler(params, bank, rng, template, stage, num_local,
+                    task_valid, same_stage):
+        base = bank.rough_duration[template, stage]
+        return (
+            base
+            + jnp.where(task_valid & same_stage, 7.0, 131.0)
+            + 17.0 * stage.astype(jnp.float32)
+        )
+
+    monkeypatch.setattr(core, "sample_task_duration", det_sampler)
+
+    params = EnvParams(
+        num_executors=5, max_jobs=6, max_stages=20, max_levels=20,
+        moving_delay=700.0, warmup_delay=500.0, job_arrival_rate=4e-5,
+        mean_time_limit=None, beta=5e-3,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    sched = DecimaScheduler(
+        num_executors=params.num_executors, embed_dim=8,
+        gnn_mlp_kwargs={"hid_dims": [16, 8], "act_cls": "LeakyReLU",
+                        "act_kwargs": {"negative_slope": 0.2}},
+        policy_mlp_kwargs={"hid_dims": [16, 16], "act_cls": "Tanh"},
+        seed=7,
+    )
+    pol = sched.flat_policy(deterministic=True)
+
+    state0 = core.reset(params, bank, jax.random.PRNGKey(3))
+    T = 160
+    ro_core = collect_sync(
+        params, bank, pol, jax.random.PRNGKey(0), T, state0
+    )
+    # different collector rng on purpose: nothing compared may depend
+    # on it. event_burst > 1 exercises the burst sub-step records and
+    # fulfill_bulk the shipped-config path where a round-finishing
+    # DECIDE micro-step jumps straight to M_EVENT, so the same group's
+    # sub-steps must discount-reference the NEW decision's wall time
+    # (the beta > 0 fixture makes a stale reference show up in rewards).
+    ro_flat = collect_flat_sync(
+        params, bank, pol, jax.random.PRNGKey(1), T, state0,
+        micro_groups=500, event_burst=2, fulfill_bulk=True,
+    )
+
+    nv = int(ro_core.valid.sum())
+    assert nv > 30, "fixture episode too short to be meaningful"
+    np.testing.assert_array_equal(
+        np.asarray(ro_core.valid), np.asarray(ro_flat.valid)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ro_core.stage_idx), np.asarray(ro_flat.stage_idx)
+    )
+    for name in ("job_idx", "num_exec_k"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ro_core, name))[:nv],
+            np.asarray(getattr(ro_flat, name))[:nv],
+            err_msg=name,
+        )
+    np.testing.assert_allclose(
+        np.asarray(ro_core.lgprob)[:nv],
+        np.asarray(ro_flat.lgprob)[:nv], rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ro_core.reward), np.asarray(ro_flat.reward),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ro_core.wall_times), np.asarray(ro_flat.wall_times),
+        rtol=1e-6,
+    )
+    for name in ("remaining", "duration", "schedulable", "node_mask",
+                 "job_mask", "job_template", "exec_supplies",
+                 "num_committable", "source_job"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ro_core.obs, name))[:nv],
+            np.asarray(getattr(ro_flat.obs, name))[:nv],
+            err_msg=f"stored obs field {name}",
+        )
+    np.testing.assert_allclose(
+        float(ro_core.final_state.wall_time),
+        float(ro_flat.final_state.wall_time), rtol=1e-6,
+    )
+
+
 @pytest.mark.parametrize(
     "dur_scale,moving_delay",
     [
