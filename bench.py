@@ -47,6 +47,7 @@ from jax import lax
 from sparksched_tpu.config import EnvParams
 from sparksched_tpu.env import core
 from sparksched_tpu.env.flat_loop import init_loop_state, run_flat
+from sparksched_tpu.obs.telemetry import summarize, telemetry_zeros_like
 from sparksched_tpu.schedulers.heuristics import round_robin_policy
 from sparksched_tpu.workload import make_workload_bank
 
@@ -99,6 +100,12 @@ _BC_CANDS = (2, 3)
 # fallback never tries these: it pins BULK_EVENTS=8 outright
 # (_wait_for_backend), which skips the whole candidate expansion.
 _BE_CANDS = (4, 16)
+# on-device telemetry counters ride the micro-step scan carry and stamp
+# the emitted row with micro-step composition + straggler ratio
+# (sparksched_tpu/obs/telemetry.py) — a dozen scalar i32 adds against a
+# multi-thousand-eqn micro-step (<5% measured on the CPU row; see
+# scripts_obs_demo.py for the A/B). BENCH_TELEMETRY=0 turns it off.
+TELEMETRY = os.environ.get("BENCH_TELEMETRY", "1") == "1"
 # set by _wait_for_backend when the accelerator never answered and the
 # run proceeded on host CPU. main() suffixes the metric name whenever
 # the executing backend is CPU — "_cpufallback" for the unattended
@@ -116,37 +123,43 @@ def _metric_suffix() -> str:
 
 @partial(jax.jit, static_argnums=(0, 4, 5, 6))
 def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events,
-                fulfill_bulk, bulk_cycles=1):
-    """MICRO_CHUNK flat micro-steps per lane; returns updated loop states
-    and the total decision count across the batch."""
+                fulfill_bulk, bulk_cycles=1, telem=None):
+    """MICRO_CHUNK flat micro-steps per lane; returns updated loop
+    states, the per-lane telemetry (or None), and the total decision
+    count across the batch."""
+    track = telem is not None
 
     def pol(rng, obs):
         si, ne = round_robin_policy(obs, params.num_executors, True)
         return si, ne, {}
 
-    def lane(ls, rng):
+    def lane(ls, rng, tm=None):
         return run_flat(
             params, bank, pol, rng, MICRO_CHUNK // BURST,
             auto_reset=False, compute_levels=False, event_burst=BURST,
             event_bulk=bulk_events > 0,
             bulk_events=max(bulk_events, 1),
             fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
-            loop_state=ls,
+            loop_state=ls, telemetry=tm,
         )
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
     sub = min(SUB_BATCH, b)
+    tree = (loop_states, rngs, telem) if track else (loop_states, rngs)
     group = jax.tree_util.tree_map(
-        lambda a: a.reshape(b // sub, sub, *a.shape[1:]),
-        (loop_states, rngs),
+        lambda a: a.reshape(b // sub, sub, *a.shape[1:]), tree
     )
-    loop_states = lax.map(
-        lambda sr: jax.vmap(lane)(sr[0], sr[1]), group
+    if track:
+        out = lax.map(
+            lambda sr: jax.vmap(lane)(sr[0], sr[1], sr[2]), group
+        )
+    else:
+        out = lax.map(lambda sr: jax.vmap(lane)(sr[0], sr[1]), group)
+    out = jax.tree_util.tree_map(
+        lambda a: a.reshape(b, *a.shape[2:]), out
     )
-    loop_states = jax.tree_util.tree_map(
-        lambda a: a.reshape(b, *a.shape[2:]), loop_states
-    )
-    return loop_states, loop_states.decisions.sum()
+    loop_states, telem = out if track else (out, None)
+    return loop_states, telem, loop_states.decisions.sum()
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -227,12 +240,13 @@ def main() -> None:
                 cands += [(b, fb, bc) for b in _BE_CANDS]
             cands += [(0, fb, bc)]
         cands = list(dict.fromkeys(cands))
+    telem = telemetry_zeros_like((NUM_ENVS,)) if TELEMETRY else None
     keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
     ok_cands = []
     for i, (be, fb, bc) in enumerate(cands):
         try:
-            ls_try, n = bench_chunk(
-                params, bank, loop_states, keys, be, fb, bc
+            ls_try, tm_try, n = bench_chunk(
+                params, bank, loop_states, keys, be, fb, bc, telem
             )
             jax.block_until_ready(n)
         except Exception as err:
@@ -244,6 +258,7 @@ def main() -> None:
             )
         else:
             loop_states = ls_try
+            telem = tm_try
             ok_cands.append((be, fb, bc))
         keys = jax.random.split(jax.random.PRNGKey(90 + i), NUM_ENVS)
     if not ok_cands:
@@ -261,8 +276,8 @@ def main() -> None:
             d0 = int(jax.block_until_ready(loop_states.decisions.sum()))
             kk = jax.random.split(jax.random.PRNGKey(70 + i), NUM_ENVS)
             tc = time.perf_counter()
-            loop_states, n = bench_chunk(
-                params, bank, loop_states, kk, be, fb, bc
+            loop_states, telem, n = bench_chunk(
+                params, bank, loop_states, kk, be, fb, bc, telem
             )
             d1 = int(jax.block_until_ready(n))
             rates[(be, fb, bc)] = (d1 - d0) / (time.perf_counter() - tc)
@@ -281,13 +296,16 @@ def main() -> None:
         jax.random.split(jax.random.PRNGKey(101), NUM_ENVS),
     )
     base = int(jax.block_until_ready(loop_states.decisions.sum()))
+    # telemetry snapshot: the emitted summary covers the timed window
+    # only, not the warmup/calibration chunks
+    telem_snap = jax.device_get(telem) if TELEMETRY else None
 
     t0 = time.perf_counter()
     for i in range(NUM_CHUNKS):
         keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
-        loop_states, n = bench_chunk(
+        loop_states, telem, n = bench_chunk(
             params, bank, loop_states, keys, bulk_events, fulfill_bulk,
-            bulk_cycles,
+            bulk_cycles, telem,
         )
         loop_states = reset_done_lanes(
             params, bank, loop_states,
@@ -302,32 +320,38 @@ def main() -> None:
     # rounds; numbers are only comparable at equal config). The lane
     # count is part of the metric name so an off-default smoke run can
     # never masquerade as the headline number.
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"env_decision_steps_per_sec_{NUM_ENVS}envs_fair_"
-                    "synthetic_tpch" + _metric_suffix()
-                ),
-                "value": round(value, 1),
-                "unit": "steps/s",
-                "vs_baseline": round(value / TARGET, 3),
-                "config": {
-                    "num_envs": NUM_ENVS,
-                    "sub_batch": SUB_BATCH,
-                    "burst": BURST,
-                    "bulk_events": int(bulk_events),
-                    "fulfill_bulk": bool(fulfill_bulk),
-                    "bulk_cycles": int(bulk_cycles),
-                    "calibrated": BULK_EVENTS is None
-                    or FULFILL_BULK is None
-                    or BULK_CYCLES is None,
-                    "prng_impl": str(jax.config.jax_default_prng_impl),
-                    "backend": jax.default_backend(),
-                },
-            }
-        )
-    )
+    row = {
+        "metric": (
+            f"env_decision_steps_per_sec_{NUM_ENVS}envs_fair_"
+            "synthetic_tpch" + _metric_suffix()
+        ),
+        "value": round(value, 1),
+        "unit": "steps/s",
+        "vs_baseline": round(value / TARGET, 3),
+        "config": {
+            "num_envs": NUM_ENVS,
+            "sub_batch": SUB_BATCH,
+            "burst": BURST,
+            "bulk_events": int(bulk_events),
+            "fulfill_bulk": bool(fulfill_bulk),
+            "bulk_cycles": int(bulk_cycles),
+            "calibrated": BULK_EVENTS is None
+            or FULFILL_BULK is None
+            or BULK_CYCLES is None,
+            "prng_impl": str(jax.config.jax_default_prng_impl),
+            "backend": jax.default_backend(),
+            # rows are only comparable at equal config: the counters
+            # ride the scan carry, so the flag is part of the config
+            # (rounds <= 6 ran telemetry-free, i.e. telemetry: false)
+            "telemetry": TELEMETRY,
+        },
+    }
+    if TELEMETRY:
+        # micro-step composition + straggler ratio over the timed
+        # window, from the same module every bench row stamps from
+        # (sparksched_tpu/obs/telemetry.py)
+        row["telemetry"] = summarize(telem, prev=telem_snap)
+    print(json.dumps(row))
 
 
 def _wait_for_backend() -> None:

@@ -30,6 +30,7 @@ import jax
 
 from sparksched_tpu.config import EnvParams
 from sparksched_tpu.env import core
+from sparksched_tpu.obs.telemetry import summarize, telemetry_zeros_like
 from sparksched_tpu.schedulers import DecimaScheduler
 from sparksched_tpu.trainers.ppo import PPO
 from sparksched_tpu.trainers.rollout import (
@@ -40,6 +41,10 @@ from sparksched_tpu.trainers.rollout import (
 from sparksched_tpu.workload import make_workload_bank
 
 TARGET = 50_000.0
+# stamp every row with engine-telemetry (micro-step composition,
+# straggler ratio — sparksched_tpu/obs/telemetry.py); BENCH_TELEMETRY=0
+# turns it off, as in bench.py
+TELEMETRY = os.environ.get("BENCH_TELEMETRY", "1") == "1"
 
 
 def _flat_knobs() -> dict:
@@ -92,37 +97,52 @@ def bench_inference(
     knobs = _flat_knobs()
     micro_per_dec = float(os.environ.get("DEC_BENCH_FLAT_MICRO", 4.0))
 
+    telem = telemetry_zeros_like((num_envs,)) if TELEMETRY else None
+    # one vmapped call covers telemetry on AND off: vmap treats a None
+    # argument as an empty pytree, and the collector's return shape
+    # switches on the Python-level None check at trace time (the same
+    # pattern as trainer._collect)
     if engine == "flat":
         micro_groups = flat_micro_group_budget(
             steps, micro_per_dec, knobs["event_burst"]
         )
 
         @jax.jit
-        def run(states, rngs):
-            return jax.vmap(
-                lambda r, s: collect_flat_sync(
-                    params, bank, pol, r, steps, s,
+        def run(states, rngs, tm):
+            out = jax.vmap(
+                lambda r, s, t: collect_flat_sync(
+                    params, bank, pol, r, steps, s, t,
                     micro_groups=micro_groups, **knobs,
                 )
-            )(rngs, states)
+            )(rngs, states, tm)
+            return out if tm is not None else (out, None)
     else:
         @jax.jit
-        def run(states, rngs):
-            return jax.vmap(
-                lambda r, s: collect_sync(params, bank, pol, r, steps, s)
-            )(rngs, states)
+        def run(states, rngs, tm):
+            out = jax.vmap(
+                lambda r, s, t: collect_sync(
+                    params, bank, pol, r, steps, s, t
+                )
+            )(rngs, states, tm)
+            return out if tm is not None else (out, None)
 
     keys = jax.random.split(jax.random.PRNGKey(0), num_envs)
     states = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
-    ro = run(states, jax.random.split(jax.random.PRNGKey(1), num_envs))
+    ro, telem = run(
+        states, jax.random.split(jax.random.PRNGKey(1), num_envs), telem
+    )
     jax.block_until_ready(ro.reward)  # compile + warm
+    telem_snap = jax.device_get(telem) if TELEMETRY else None
 
     t0 = time.perf_counter()
     n_timed = 2
     total = 0
     for i in range(n_timed):
-        ro = run(states, jax.random.split(jax.random.PRNGKey(2 + i),
-                                          num_envs))
+        ro, telem = run(
+            states,
+            jax.random.split(jax.random.PRNGKey(2 + i), num_envs),
+            telem,
+        )
         total += int(jax.block_until_ready(ro.valid).sum())
     dt = time.perf_counter() - t0
     value = total / dt
@@ -133,17 +153,21 @@ def bench_inference(
         "engine": engine,
         "prng_impl": str(jax.config.jax_default_prng_impl),
         "backend": jax.default_backend(),
+        "telemetry": TELEMETRY,
     }
     if engine == "flat":
         cfg |= {"micro_per_decision": micro_per_dec} | knobs
-    print(json.dumps({
+    row = {
         "metric": f"decima_infer_steps_per_sec_{num_envs}envs{tag}"
                   f"{eng_tag}",
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
         "config": cfg,
-    }), flush=True)
+    }
+    if TELEMETRY:
+        row["telemetry"] = summarize(telem, prev=telem_snap)
+    print(json.dumps(row), flush=True)
 
 
 def bench_ppo(
@@ -211,31 +235,37 @@ def bench_ppo(
             "flat_fulfill_bulk": knobs["fulfill_bulk"],
             "flat_bulk_cycles": knobs["bulk_cycles"],
         }
-    trainer = PPO(cfg_agent, cfg_env, cfg_train)
+    trainer = PPO(
+        cfg_agent, cfg_env, cfg_train,
+        obs_cfg={"telemetry": TELEMETRY, "runlog": False},
+    )
     state = trainer.init_state()
 
     def one_iter(state, i):
-        ro, _ = trainer._collect_jit(
+        ro, _, telem = trainer._collect_jit(
             state.params, state.iteration,
             jax.random.fold_in(state.rng, i), None,
         )
         state, stats = trainer._update_jit(state, ro)
-        return state, ro
+        return state, ro, telem
 
-    state, ro = one_iter(state, 0)  # compile + warm
+    state, ro, _ = one_iter(state, 0)  # compile + warm
     jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
     n_timed = 2
     total = 0
+    summaries = []
     for i in range(1, 1 + n_timed):
-        state, ro = one_iter(state, i)
+        state, ro, telem = one_iter(state, i)
         total += int(jax.block_until_ready(ro.valid).sum())
+        if telem is not None:
+            summaries.append(summarize(telem))
     dt = time.perf_counter() - t0
     value = total / dt
     tag = f"_{compute_dtype}" if compute_dtype else ""
     eng_tag = "_flat" if engine == "flat" else ""
-    print(json.dumps({
+    row = {
         "metric": f"ppo_train_steps_per_sec_{num_envs}envs{tag}{eng_tag}",
         "value": round(value, 1),
         "unit": "steps/s",
@@ -246,8 +276,12 @@ def bench_ppo(
             "engine": engine,
             "prng_impl": str(jax.config.jax_default_prng_impl),
             "backend": jax.default_backend(),
+            "telemetry": TELEMETRY,
         },
-    }), flush=True)
+    }
+    if summaries:
+        row["telemetry"] = summaries[-1]
+    print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

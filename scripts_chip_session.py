@@ -17,6 +17,13 @@ Stages (each guarded; a failure logs and moves on):
      starve the subprocess of the chip grant.
   8. Decima flat-engine benches (rollout collection via the flat
      micro-step engine + flat-collector PPO)
+  9. labeled device trace: a short flat-engine chunk + Decima policy
+     under jax.profiler with the obs.tracing annotations, written to
+     artifacts/trace_chip for Perfetto (PERF.md "Reading a run")
+
+Every bench row (stages 3/4/8) is stamped with the on-device telemetry
+summary — micro-step composition, straggler ratio, events/decision —
+by bench.py / bench_decima.py themselves (sparksched_tpu/obs).
 
 Usage: python scripts_chip_session.py [stage ...]   (default: 1 2 3 4)
 """
@@ -223,6 +230,54 @@ def stage_bench_1024():
     print(f"[bench-1024] subprocess rc={r.returncode}", flush=True)
 
 
+def stage_obs_trace():
+    """Labeled device trace (obs tentpole): run one flat micro-step
+    chunk with the Decima policy under jax.profiler so the captured
+    Perfetto timeline carries the decima/gnn, env/micro_step and
+    collect/scatter annotation scopes. Small lane count — this stage is
+    about trace legibility, not throughput."""
+    _mark_client_held()
+    import jax
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.schedulers import DecimaScheduler
+    from sparksched_tpu.trainers.profiler import Profiler
+    from sparksched_tpu.trainers.rollout import collect_flat_sync
+    from sparksched_tpu.workload import make_workload_bank
+
+    params = EnvParams(num_executors=10, max_jobs=50, max_stages=20)
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    sched = DecimaScheduler(
+        num_executors=params.num_executors, embed_dim=16,
+        gnn_mlp_kwargs={"hid_dims": [32, 16], "act_cls": "LeakyReLU",
+                        "act_kwargs": {"negative_slope": 0.2}},
+        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+    )
+    pol = sched.flat_policy()
+    keys = jax.random.split(jax.random.PRNGKey(0), 16)
+    states = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
+
+    def run(rngs):
+        return jax.vmap(
+            lambda r, s: collect_flat_sync(
+                params, bank, pol, r, 64, s, micro_groups=256,
+            )
+        )(rngs, states)
+
+    ro = run(jax.random.split(jax.random.PRNGKey(1), 16))
+    jax.block_until_ready(ro.reward)  # compile outside the trace
+    with Profiler("artifacts/trace_chip", "obs trace"):
+        ro = run(jax.random.split(jax.random.PRNGKey(2), 16))
+        jax.block_until_ready(ro.reward)
+    print("[obs-trace] wrote artifacts/trace_chip "
+          "(open in Perfetto / xprof; phases labeled decima/gnn, "
+          "env/micro_step, collect/scatter)", flush=True)
+
+
 STAGES = {
     "1": ("sanity", stage_sanity),
     "2": ("burst sweep", stage_sweep),
@@ -232,6 +287,7 @@ STAGES = {
     "6": ("bulk probe", stage_bulk_probe),
     "7": ("headline bench, sub-batch 1024", stage_bench_1024),
     "8": ("decima flat-engine benches", stage_bench_decima_flat),
+    "9": ("labeled device trace", stage_obs_trace),
 }
 
 

@@ -118,14 +118,23 @@ print('ALIVE')
     # 2 h training session ahead of the three short evidence rows the
     # VERDICT explicitly asks for (stage 4 is now per-row guarded, so
     # one dead compile no longer forfeits the stage).
-    timeout -k 60 2700 python scripts_chip_session.py 4
-    echo "decima-bench rc=$? at $(date +%H:%M:%S)"
+    # stage-4 budget raised 2700 -> 3600 (round-5 advisor: 4 full-compile
+    # rows against 2700 s in ~25-min tunnel windows meant the last row
+    # was routinely truncated); rc=124 additionally logs an explicit
+    # TRUNCATION_EXPECTED marker so artifact readers never misread a
+    # missing trailing row as a per-row failure.
+    timeout -k 60 3600 python scripts_chip_session.py 4
+    rc=$?
+    echo "decima-bench rc=$rc at $(date +%H:%M:%S)"
+    [ "$rc" -eq 124 ] && echo "TRUNCATION_EXPECTED: stage 4 hit its 3600s budget; trailing rows were cut by the watcher, not by row failures"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # round-6: decima_flat rows (flat-engine rollout collection — the
     # training fast path this round routed Decima through). Separate
     # stage so a truncated stage-4 window doesn't forfeit these rows.
     timeout -k 60 2700 python scripts_chip_session.py 8
-    echo "decima-flat-bench rc=$? at $(date +%H:%M:%S)"
+    rc=$?
+    echo "decima-flat-bench rc=$rc at $(date +%H:%M:%S)"
+    [ "$rc" -eq 124 ] && echo "TRUNCATION_EXPECTED: stage 8 hit its 2700s budget; trailing rows were cut by the watcher, not by row failures"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable
     # sessions (state saved every session; a wedge mid-session loses at

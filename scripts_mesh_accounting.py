@@ -77,7 +77,7 @@ def main() -> None:
         comp_c = lowered_c.compile()
         # execute through the AOT-compiled object (a fresh
         # t._collect_jit call would re-trace and recompile)
-        ro, _ = comp_c(state.params, state.iteration, state.rng, None)
+        ro, _, _ = comp_c(state.params, state.iteration, state.rng, None)
         shard_shape = ro.obs.duration.sharding.shard_shape(
             ro.obs.duration.shape
         )

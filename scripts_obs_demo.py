@@ -1,0 +1,246 @@
+"""Observability demo — single command, CPU, tier-1-safe:
+
+    JAX_PLATFORMS=cpu python scripts_obs_demo.py
+
+Exercises the full obs subsystem (sparksched_tpu/obs) end to end and
+writes `artifacts/runlog/obs_demo.jsonl`:
+
+1. drives the SAME deterministic workload through BOTH rollout engines
+   (`core` per-decision step loop and `flat` micro-step engine) with
+   on-device telemetry, 8 vmapped lanes at a fixed seed;
+2. logs one `telemetry` record per engine — micro-step composition,
+   per-kind event totals, and the measured while-loop straggler ratio
+   (max/mean per-lane iteration counts) — plus timed spans;
+3. asserts the cross-engine invariants: identical DECIDE counts and
+   per-kind event totals between the engines (exit 1 on mismatch);
+4. A/B-times the flat fair-policy bench chunk with telemetry on vs off
+   and reports the overhead (acceptance bar: < 5%).
+
+The task-duration sampler is pinned to a deterministic table lookup for
+the parity section (the two engines draw from legitimately different
+rng STREAMS on stochastic banks — PERF.md operational rules — so only a
+deterministic sampler makes trajectories, and therefore counts,
+comparable). The overhead section runs the stock sampler.
+"""
+
+from __future__ import annotations
+
+import time
+
+from sparksched_tpu.config import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from sparksched_tpu.config import EnvParams  # noqa: E402
+from sparksched_tpu.env import core  # noqa: E402
+from sparksched_tpu.env.flat_loop import run_flat  # noqa: E402
+from sparksched_tpu.env.observe import observe  # noqa: E402
+from sparksched_tpu.obs import RunLog, emit  # noqa: E402
+from sparksched_tpu.obs.telemetry import (  # noqa: E402
+    summarize,
+    telemetry_zeros_like,
+)
+from sparksched_tpu.schedulers.heuristics import (  # noqa: E402
+    round_robin_policy,
+)
+from sparksched_tpu.workload import make_workload_bank  # noqa: E402
+
+LANES = 8
+SEED = 3
+
+
+def _det_sampler(params, bank, rng, template, stage, num_local,
+                 task_valid, same_stage):
+    """Deterministic stand-in for sample_task_duration (the fixture trick
+    tests/test_flat_loop.py uses): distinct per continuation kind and
+    stage so wave logic still shapes trajectories, rng-free."""
+    base = bank.rough_duration[template, stage]
+    return (
+        base
+        + jnp.where(task_valid & same_stage, 7.0, 131.0)
+        + 17.0 * stage.astype(jnp.float32)
+    )
+
+
+def parity_section(log: RunLog) -> bool:
+    params = EnvParams(
+        num_executors=6, max_jobs=8, max_stages=20, max_levels=20,
+        moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
+        mean_time_limit=None,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    stock = core.sample_task_duration
+    core.sample_task_duration = _det_sampler
+    try:
+        keys = jax.random.split(jax.random.PRNGKey(SEED), LANES)
+        states = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
+
+        # ---- core engine: per-decision step loop, frozen at done
+        @jax.jit
+        def core_chunk(state, tm):
+            def body(carry, _):
+                st, tm = carry
+                done = st.terminated | st.truncated
+                obs = observe(params, st)
+                si, ne = round_robin_policy(
+                    obs, params.num_executors, True
+                )
+                st2, _, _, _, tm2 = core.step(
+                    params, bank, st, si, ne, telemetry=tm
+                )
+                sel = lambda a, b: jnp.where(done, a, b)  # noqa: E731
+                st = jax.tree_util.tree_map(sel, st, st2)
+                tm = jax.tree_util.tree_map(sel, tm, tm2)
+                return (st, tm), None
+
+            return jax.lax.scan(body, (state, tm), None, length=100)[0]
+
+        tm_core = telemetry_zeros_like((LANES,))
+        with log.span("engine core", engine="core"):
+            st, tm_core = states, tm_core
+            for _ in range(40):
+                st, tm_core = jax.vmap(core_chunk)(st, tm_core)
+                if bool(st.terminated.all()):
+                    break
+        assert bool(st.terminated.all()), "core episodes did not finish"
+        sum_core = summarize(tm_core)
+        log.telemetry(sum_core, engine="core")
+
+        # ---- flat engine: micro-step loop, frozen at done
+        def pol(rng, obs):
+            si, ne = round_robin_policy(obs, params.num_executors, True)
+            return si, ne, {}
+
+        flat = jax.jit(
+            lambda s, r, t: run_flat(
+                params, bank, pol, r, 4000, s, auto_reset=False,
+                telemetry=t,
+            )
+        )
+        with log.span("engine flat", engine="flat"):
+            ls, tm_flat = jax.vmap(
+                lambda s, r, t: flat(s, r, t)
+            )(states, jax.random.split(jax.random.PRNGKey(0), LANES),
+              telemetry_zeros_like((LANES,)))
+            jax.block_until_ready(ls.decisions)
+        assert int(ls.episodes.sum()) == LANES, "flat episodes open"
+        sum_flat = summarize(tm_flat)
+        log.telemetry(sum_flat, engine="flat")
+
+        emit(f"core: decisions={sum_core['decisions']} "
+             f"straggler_ratio={sum_core['straggler_ratio']} "
+             f"composition={sum_core['composition']} "
+             f"events={sum_core['events_by_kind']}")
+        emit(f"flat: decisions={sum_flat['decisions']} "
+             f"straggler_ratio={sum_flat['straggler_ratio']} "
+             f"composition={sum_flat['composition']} "
+             f"events={sum_flat['events_by_kind']}")
+
+        ok = True
+        for key in ("decisions", "events_by_kind", "fulfillments",
+                    "commit_rounds"):
+            if sum_core[key] != sum_flat[key]:
+                emit(f"PARITY MISMATCH on {key}: "
+                     f"core={sum_core[key]} flat={sum_flat[key]}")
+                ok = False
+        if ok:
+            emit(f"PARITY OK: both engines report "
+                 f"{sum_core['decisions']} DECIDEs and identical "
+                 "per-kind event totals at seed "
+                 f"{SEED} across {LANES} lanes")
+        log.write("parity", ok=ok, decisions_core=sum_core["decisions"],
+                  decisions_flat=sum_flat["decisions"])
+        return ok
+    finally:
+        core.sample_task_duration = stock
+
+
+def overhead_section(log: RunLog) -> float:
+    """Flat fair-policy bench chunk (bench.py's shape, reduced lanes),
+    telemetry on vs off; returns overhead %."""
+    params = EnvParams(num_executors=10, max_jobs=50, max_stages=20)
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    n_envs, chunk = 32, 256
+
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    def lane(ls, rng, tm):
+        return run_flat(
+            params, bank, pol, rng, chunk, auto_reset=False,
+            compute_levels=False, fulfill_bulk=True, loop_state=ls,
+            telemetry=tm,
+        )
+
+    run_on = jax.jit(jax.vmap(lane))
+    run_off = jax.jit(jax.vmap(lambda ls, rng: lane(ls, rng, None)))
+
+    from sparksched_tpu.env.flat_loop import init_loop_state
+
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    states = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
+    ls0 = jax.vmap(init_loop_state)(states)
+    tm0 = telemetry_zeros_like((n_envs,))
+
+    def once(fn, *args):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+        return time.perf_counter() - t0
+
+    # warm/compile both arms, plus one discarded run each (the first
+    # post-compile executions drift slow while the allocator warms up),
+    # then INTERLEAVE the timed runs so box-level drift hits both arms
+    # equally — a sequential best-of-N here measured ±20% on the 1-core
+    # box where the interleaved median measures ~1%
+    for _ in range(2):
+        once(run_off, ls0, keys)
+        once(run_on, ls0, keys, tm0)
+    offs, ons = [], []
+    for _ in range(5):
+        offs.append(once(run_off, ls0, keys))
+        ons.append(once(run_on, ls0, keys, tm0))
+    offs.sort()
+    ons.sort()
+    t_off, t_on = offs[len(offs) // 2], ons[len(ons) // 2]
+    pct = 100.0 * (t_on - t_off) / t_off
+    emit(f"flat fair-policy chunk ({n_envs} lanes x {chunk} "
+         f"micro-steps): telemetry off {t_off*1e3:.1f} ms, "
+         f"on {t_on*1e3:.1f} ms -> overhead {pct:+.2f}% "
+         f"({'PASS' if pct < 5.0 else 'FAIL'}, bar: <5%)")
+    log.write("overhead", telemetry_off_secs=round(t_off, 4),
+              telemetry_on_secs=round(t_on, 4),
+              overhead_pct=round(pct, 2), passed=pct < 5.0)
+    return pct
+
+
+def main() -> int:
+    import contextlib
+    import os
+
+    # fixed path + fresh file per demo run (RunLog appends by design;
+    # the demo should leave exactly one run's records behind)
+    with contextlib.suppress(FileNotFoundError):
+        os.remove("artifacts/runlog/obs_demo.jsonl")
+    log = RunLog("artifacts/runlog/obs_demo.jsonl")
+    log.install_jit_hooks()
+    log.write("run_start", demo="obs", lanes=LANES, seed=SEED)
+    ok = parity_section(log)
+    pct = overhead_section(log)
+    log.close(parity_ok=ok, overhead_pct=round(pct, 2))
+    emit(f"runlog written: {log.path}")
+    return 0 if ok and pct < 5.0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

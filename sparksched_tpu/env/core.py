@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import EnvParams
+from ..obs.telemetry import add as _tm_add
 from ..workload.bank import WorkloadBank
 from ..workload.sampling import sample_job_sequence, sample_task_duration
 from .state import (
@@ -715,8 +716,8 @@ def _bulk_fulfill(
 
 def _fulfill_from_source(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
-    active: jnp.ndarray, bulk: bool = True
-) -> EnvState:
+    active: jnp.ndarray, bulk: bool = True, telem=None
+):
     """reference :730-743 — match the source pool's idle executors against
     its outstanding commitments, in commitment insertion order. `active`
     masks the whole call (used to fold the reference's round-finished
@@ -724,7 +725,10 @@ def _fulfill_from_source(
     the phase is consumed in one `_bulk_fulfill` pass and only the
     backup-scheduling tail (usually empty) runs the per-candidate
     while-loop — under vmap the loop runs the batch-max LEFTOVER count
-    instead of a fixed N iterations."""
+    instead of a fixed N iterations. With `telem` (an `obs.Telemetry`),
+    returns `(state, telem)` with bulk hits and per-candidate
+    fulfillments counted; the None path threads nothing."""
+    track = telem is not None
     n = state.exec_job.shape[0]
     idle = state.source_pool_mask() & ~state.exec_executing
     num_idle = jnp.where(active, idle.sum(), 0)
@@ -741,15 +745,19 @@ def _fulfill_from_source(
         state, k0 = _bulk_fulfill(
             params, bank, state, num_idle, exec_order, slot_order
         )
+        if track:
+            telem = _tm_add(telem, bulk_fulfill_hits=k0)
     else:
         k0 = _i32(0)
 
     def cond(carry):
-        k, _ = carry
-        return k < num_idle
+        return carry[0] < num_idle
 
     def body(carry):
-        k, st = carry
+        if track:
+            k, st, tm = carry
+        else:
+            k, st = carry
         e = exec_order[k]
         quirk_src = st.source_job_id()
         st, rk, rj, rs = _fulfill_commitment_phase_a(
@@ -758,8 +766,16 @@ def _fulfill_from_source(
         ak, tj, ts = _resolve_action(
             params, st, rk, e, rj, rs, quirk_src
         )
-        return k + 1, _apply_action(params, bank, st, ak, e, tj, ts)
+        st = _apply_action(params, bank, st, ak, e, tj, ts)
+        if track:
+            return k + 1, st, _tm_add(tm, fulfill_steps=1)
+        return k + 1, st
 
+    if track:
+        _, state, telem = lax.while_loop(
+            cond, body, (k0, state, telem)
+        )
+        return state, telem
     _, state = lax.while_loop(cond, body, (k0, state))
     return state
 
@@ -1427,8 +1443,9 @@ def _bulk_ready(
 
 def _resume_simulation(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
-    active: jnp.ndarray, bulk: bool = True, bulk_events: int = 8
-) -> EnvState:
+    active: jnp.ndarray, bulk: bool = True, bulk_events: int = 8,
+    telem=None,
+):
     """Pop events until there are new scheduling decisions to make or the
     queue drains (reference :320-343). `active` masks the whole loop.
     With `bulk`, each iteration first consumes a whole run of relaunch
@@ -1440,13 +1457,25 @@ def _resume_simulation(
     committable > 0, and `_bulk_ready` ends its prefix at any arrival
     that could raise it). Under vmap the while loop costs the batch-max
     iteration count, so consuming bulk + cutter per iteration cuts the
-    straggler tax for every lane."""
+    straggler tax for every lane.
 
-    def cond(st: EnvState) -> jnp.ndarray:
+    With `telem` (an `obs.Telemetry`), returns `(state, telem)` counting
+    each lane's own iteration count (`loop_iters` — the while batching
+    rule masks the carry for false-cond lanes, so the count is per-lane
+    exact and max/mean over lanes IS the straggler tax), single pops by
+    event kind, and bulk-pass consumption. None threads nothing."""
+    track = telem is not None
+
+    def cond(carry):
+        st = carry[0] if track else carry
         has, _, _, _ = _next_event(params, st)
         return active & has & ~st.round_ready
 
-    def body(st: EnvState) -> EnvState:
+    def body(carry):
+        if track:
+            st, tm = carry
+        else:
+            st, tm = carry, None
         if bulk:
             st, nb1 = _bulk_relaunch(
                 params, bank, st, jnp.bool_(True),
@@ -1454,11 +1483,25 @@ def _resume_simulation(
             )
             st, nb2 = _bulk_ready(params, bank, st, jnp.bool_(True))
             single = ((nb1 + nb2) == 0) | (st.num_committable() == 0)
+            if track:
+                tm = _tm_add(
+                    tm, bulk_relaunch_events=nb1, bulk_ready_events=nb2
+                )
         else:
             single = jnp.bool_(True)
         # `has` must re-gate the fused pop: the bulk passes above may
         # have consumed the queue's last events (e.g. a parked arrival)
         has, t, kind, arg = _next_event(params, st)
+        if track:
+            did_pop = single & has
+            tm = _tm_add(
+                tm,
+                loop_iters=1,
+                event_steps=did_pop,
+                ev_job_arrival=did_pop & (kind == EV_JOB_ARRIVAL),
+                ev_task_finished=did_pop & (kind == EV_TASK_FINISHED),
+                ev_exec_ready=did_pop & (kind == EV_EXECUTOR_READY),
+            )
 
         def pop(st: EnvState):
             st = st.replace(wall_time=t)
@@ -1506,8 +1549,11 @@ def _resume_simulation(
                 committable > 0, move_and_clear, lambda s2: s2, st
             )
 
-        return lax.cond(ready, set_ready, not_ready, st)
+        st = lax.cond(ready, set_ready, not_ready, st)
+        return (st, tm) if track else st
 
+    if track:
+        return lax.while_loop(cond, body, (state, telem))
     return lax.while_loop(cond, body, state)
 
 
@@ -1645,7 +1691,7 @@ def reset_from_sequence(
 def step(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
     stage_idx: jnp.ndarray, num_exec: jnp.ndarray, *, bulk: bool = True,
-    bulk_events: int = 8
+    bulk_events: int = 8, telemetry=None
 ):
     """One decision step (reference :188-221). Returns
     (state, reward, terminated, truncated). `bulk=False` forces BOTH
@@ -1653,7 +1699,14 @@ def step(
     iteration (`_bulk_relaunch`) and the fulfillment phase runs one
     candidate at a time (`_bulk_fulfill`) — for equivalence testing;
     the rng streams of the two modes differ (per-candidate pre-derived
-    keys vs the sequential chain)."""
+    keys vs the sequential chain).
+
+    With `telemetry` (an `obs.Telemetry`), returns a 5-tuple with the
+    counters advanced — decisions/rounds on live lanes, event-loop
+    iterations and event kinds (see `obs.telemetry` for semantics).
+    The default None path is bit-identical to the pre-telemetry step
+    and threads no extra carry."""
+    track = telemetry is not None
     s_cap = params.max_stages
     j = stage_idx // s_cap
     s = stage_idx % s_cap
@@ -1662,6 +1715,8 @@ def step(
         & (stage_idx < params.num_nodes)
         & state.schedulable[j, s]
     )
+    if track:
+        live = ~(state.terminated | state.truncated)
 
     def do_commit(st: EnvState) -> EnvState:
         committable = st.num_committable()
@@ -1688,7 +1743,19 @@ def step(
         return _commit_remaining(st)
 
     state = lax.cond(active, commit_rest, lambda st: st, state)
-    state = _fulfill_from_source(params, bank, state, active, bulk=bulk)
+    if track:
+        telemetry = _tm_add(
+            telemetry,
+            decide_steps=live,
+            commit_rounds=active & live,
+        )
+        state, telemetry = _fulfill_from_source(
+            params, bank, state, active, bulk=bulk, telem=telemetry
+        )
+    else:
+        state = _fulfill_from_source(
+            params, bank, state, active, bulk=bulk
+        )
 
     def clear_round(st: EnvState) -> EnvState:
         return st.replace(
@@ -1703,9 +1770,16 @@ def step(
     state = lax.cond(active, clear_round, lambda st: st, state)
     t_old = state.wall_time
     active_old = state.job_active
-    state = _resume_simulation(
-        params, bank, state, active, bulk=bulk, bulk_events=bulk_events
-    )
+    if track:
+        state, telemetry = _resume_simulation(
+            params, bank, state, active, bulk=bulk,
+            bulk_events=bulk_events, telem=telemetry,
+        )
+    else:
+        state = _resume_simulation(
+            params, bank, state, active, bulk=bulk,
+            bulk_events=bulk_events,
+        )
     reward = jnp.where(
         active, -_compute_jobtime(params, state, t_old, active_old), 0.0
     )
@@ -1713,4 +1787,6 @@ def step(
     terminated = state.all_jobs_complete
     truncated = state.wall_time >= state.time_limit
     state = state.replace(terminated=terminated, truncated=truncated)
+    if track:
+        return state, reward, terminated, truncated, telemetry
     return state, reward, terminated, truncated

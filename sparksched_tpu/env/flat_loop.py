@@ -34,6 +34,8 @@ from flax import struct
 from jax import lax
 
 from ..config import EnvParams
+from ..obs.telemetry import add as _tm_add
+from ..obs.tracing import annotate
 from ..workload.bank import WorkloadBank
 from .core import (
     RQ_NONE,
@@ -56,7 +58,13 @@ from .core import (
     find_schedulable,
 )
 from .observe import observe
-from .state import BIG_SEQ, EnvState
+from .state import (
+    BIG_SEQ,
+    EV_EXECUTOR_READY,
+    EV_JOB_ARRIVAL,
+    EV_TASK_FINISHED,
+    EnvState,
+)
 
 _i32 = jnp.int32
 
@@ -131,8 +139,10 @@ def init_loop_state(state: EnvState) -> LoopState:
 def _pop_event(params: EnvParams, st: EnvState, enabled):
     """Pop + handle one event (core._resume_simulation body). Shared by
     the full micro-step's EVENT branch and `event_micro_step` so the two
-    can never drift. Returns (state, req_kind, rj, rs, event_arg, quirk);
-    a no-op (RQ_NONE) when `enabled` is False or the queue is drained."""
+    can never drift. Returns
+    (state, req_kind, rj, rs, event_arg, quirk, popped, kind);
+    a no-op (RQ_NONE, popped=False) when `enabled` is False or the
+    queue is drained. `popped`/`kind` feed the telemetry counters."""
     has, t, kind, arg = _next_event(params, st)
 
     def pop(st: EnvState):
@@ -153,8 +163,9 @@ def _pop_event(params: EnvParams, st: EnvState, enabled):
     def drained(st: EnvState):
         return st, _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(-1)
 
-    st, rk, rj, rs, quirk = lax.cond(enabled & has, pop, drained, st)
-    return st, rk, rj, rs, arg, quirk
+    popped = enabled & has
+    st, rk, rj, rs, quirk = lax.cond(popped, pop, drained, st)
+    return st, rk, rj, rs, arg, quirk, popped, kind
 
 
 def _bulk_cycle_chain(
@@ -172,8 +183,12 @@ def _bulk_cycle_chain(
     (round-ready flip and move_and_clear are gated on committable > 0)
     and the wall clock inside the episode limit (the freeze point) — so
     chaining is exactly the next micro-step's bulk phase minus its
-    provably-no-op tail. Returns (env, events_consumed)."""
+    provably-no-op tail. Returns
+    (env, events_consumed, relaunch_events, ready_events) — the last
+    two split the count by pass kind for the telemetry counters."""
     nb = _i32(0)
+    nb_rel = _i32(0)
+    nb_rdy = _i32(0)
     for i in range(bulk_cycles):
         on = is_event if i == 0 else (
             is_event
@@ -192,7 +207,9 @@ def _bulk_cycle_chain(
             stop_at_limit=True,
         )
         nb = nb + nbi1 + nbi2
-    return env, nb
+        nb_rel = nb_rel + nbi1
+        nb_rdy = nb_rdy + nbi2
+    return env, nb, nb_rel, nb_rdy
 
 
 def _fused_pop_gate(env: EnvState, nb: jnp.ndarray) -> jnp.ndarray:
@@ -233,7 +250,8 @@ def micro_step(
     record: bool = False,
     reset_fn: Callable | None = None,
     t_ref: jnp.ndarray | None = None,
-) -> LoopState | tuple[LoopState, MicroRec]:
+    telemetry=None,
+) -> LoopState | tuple:
     """One unit of work for one lane (vmap over lanes). With
     `event_bulk`, an EVENT micro-step consumes a whole run of relaunch
     events via `core._bulk_relaunch` (hoisted above the mode switch —
@@ -271,17 +289,25 @@ def micro_step(
     which the async collector maps to the group-shared reset ordinal.
     `t_ref` is the discount reference wall time for the recorded reward
     (the wall time of the round-finishing decision; only read when
-    `params.beta > 0`)."""
+    `params.beta > 0`).
+
+    With `telemetry` (an `obs.Telemetry`, static None check), the
+    counters are advanced on live lanes — micro-step composition by
+    entry mode, events consumed (`loop_iters`), pops by kind, bulk-pass
+    consumption — and the return gains a trailing telemetry element:
+    `(ls[, rec], telemetry)`. The None path threads nothing."""
+    track = telemetry is not None
     k_pol, k_reset = jax.random.split(rng)
     ls0 = ls  # pre-bulk state: the freeze path must restore exactly this
     if event_bulk:
-        env_b, nb = _bulk_cycle_chain(
+        env_b, nb, nb_rel, nb_rdy = _bulk_cycle_chain(
             params, bank, ls.env, ls.mode == M_EVENT, bulk_events,
             bulk_cycles,
         )
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
     else:
         nb = _i32(0)
+        nb_rel = nb_rdy = nb
     st = ls.env
     n = st.exec_job.shape[0]
     s_cap = params.max_stages
@@ -385,7 +411,8 @@ def micro_step(
             exec_order=eo,
             slot_order=so,
             decisions=ls.decisions + 1,
-        ), _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(0), st.source_job_id()
+        ), _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(0), \
+            st.source_job_id(), jnp.bool_(False), _i32(0)
 
     # ---- FULFILL: one commitment fulfillment (core._fulfill_from_source
     # body, one k per micro-step)
@@ -409,35 +436,56 @@ def micro_step(
         # backup-stage search must still see stage_selected
         mode = jnp.where(last, M_EVENT, M_FULFILL).astype(_i32)
         return ls.replace(env=st, mode=mode, fulfill_k=k + 1), rk, rj, rs, \
-            e, quirk
+            e, quirk, jnp.bool_(False), _i32(0)
 
     # ---- EVENT: one event pop + handling (core._resume_simulation
     # body). Fused pop: even after the bulk passes consumed events, the
     # run-cutting event they stopped at is popped in the same micro-step
     # when the skipped between-event tail is provably a no-op
     def event(ls: LoopState):
-        st, rk, rj, rs, arg, quirk = _pop_event(
+        st, rk, rj, rs, arg, quirk, popped, kind = _pop_event(
             params, ls.env, _fused_pop_gate(ls.env, nb)
         )
-        return ls.replace(env=st), rk, rj, rs, arg, quirk
+        return ls.replace(env=st), rk, rj, rs, arg, quirk, popped, kind
 
-    ls2, rk, rj, rs, e, quirk = lax.switch(
-        ls.mode, [decide, fulfill, event], ls
-    )
-    out = _finish_micro_step(
-        params, bank, ls0, ls2, rk, rj, rs, e, quirk, k_reset, auto_reset,
-        fulfill_bulk=fulfill_bulk, record=record, reset_fn=reset_fn,
-        t_ref=t_ref,
-    )
-    if not record:
-        return out
-    ls_f, (r_reward, r_dt, r_reset) = out
+    with annotate("env/micro_step"):
+        ls2, rk, rj, rs, e, quirk, popped, ev_kind = lax.switch(
+            ls.mode, [decide, fulfill, event], ls
+        )
+        out = _finish_micro_step(
+            params, bank, ls0, ls2, rk, rj, rs, e, quirk, k_reset,
+            auto_reset, fulfill_bulk=fulfill_bulk, record=record,
+            reset_fn=reset_fn, t_ref=t_ref, telem=telemetry,
+        )
+    if track:
+        *out, telemetry = out
+        out = out[0] if len(out) == 1 else tuple(out)
     # frozen lanes (auto_reset=False, episode already over at entry) must
     # not report a decision — the tail rolls their state/counters back
     was_done = (
         ls0.env.all_jobs_complete
         | (ls0.env.wall_time >= ls0.env.time_limit)
     )
+    if track:
+        live = ~was_done
+        pop_live = popped & live
+        telemetry = _tm_add(
+            telemetry,
+            decide_steps=(ls0.mode == M_DECIDE) & live,
+            fulfill_steps=(ls0.mode == M_FULFILL) & live,
+            event_steps=(ls0.mode == M_EVENT) & live,
+            commit_rounds=(ls0.mode == M_DECIDE) & live
+            & (ls2.mode != M_DECIDE),
+            loop_iters=jnp.where(live, nb + popped.astype(_i32), 0),
+            bulk_relaunch_events=jnp.where(live, nb_rel, 0),
+            bulk_ready_events=jnp.where(live, nb_rdy, 0),
+            ev_job_arrival=pop_live & (ev_kind == EV_JOB_ARRIVAL),
+            ev_task_finished=pop_live & (ev_kind == EV_TASK_FINISHED),
+            ev_exec_ready=pop_live & (ev_kind == EV_EXECUTOR_READY),
+        )
+    if not record:
+        return (out, telemetry) if track else out
+    ls_f, (r_reward, r_dt, r_reset) = out
     rec = MicroRec(
         obs=r_obs,
         stage_idx=r_stage,
@@ -449,7 +497,7 @@ def micro_step(
         dt=r_dt,
         reset=r_reset,
     )
-    return ls_f, rec
+    return (ls_f, rec, telemetry) if track else (ls_f, rec)
 
 
 def _finish_micro_step(
@@ -468,12 +516,15 @@ def _finish_micro_step(
     record: bool = False,
     reset_fn: Callable | None = None,
     t_ref: jnp.ndarray | None = None,
-) -> LoopState | tuple[LoopState, tuple]:
+    telem=None,
+) -> LoopState | tuple:
     """Shared micro-step tail: move resolution/application, round clearing
     and readiness, episode end. `ls` is the pre-step state, `ls2` the
     state after the mode branch ran. With `record`, also returns the
     micro-step's `(reward, dt, reset)` triple, measured on the pre-reset
-    state and zeroed for frozen lanes (see `MicroRec`).
+    state and zeroed for frozen lanes (see `MicroRec`). With `telem`,
+    the bulk-fulfillment hit count is added (live lanes only) and the
+    telemetry is returned as the trailing element.
 
     With `fulfill_bulk`, a DECIDE micro-step that just finished a
     commitment round (mode went DECIDE -> FULFILL) consumes the
@@ -492,6 +543,14 @@ def _finish_micro_step(
         st, k0 = _bulk_fulfill(
             params, bank, st, ni, ls2.exec_order, ls2.slot_order
         )
+        if telem is not None:
+            live = ~(
+                ls.env.all_jobs_complete
+                | (ls.env.wall_time >= ls.env.time_limit)
+            )
+            telem = _tm_add(
+                telem, bulk_fulfill_hits=jnp.where(live, k0, 0)
+            )
         # phase complete (empty, or fully consumed by the pass): clear
         # and go straight to events — matching core.step, which clears
         # only after _fulfill_from_source returns (no leftover backup
@@ -594,7 +653,10 @@ def _finish_micro_step(
         mode=mode,
         episodes=ls2.episodes + (done & ~was_done).astype(_i32),
     )
-    return (out, rec_tail) if record else out
+    ret = (out, rec_tail) if record else (out,)
+    if telem is not None:
+        ret = ret + (telem,)
+    return ret[0] if len(ret) == 1 else ret
 
 
 def event_micro_step(
@@ -609,11 +671,14 @@ def event_micro_step(
     record: bool = False,
     reset_fn: Callable | None = None,
     t_ref: jnp.ndarray | None = None,
-) -> LoopState | tuple[LoopState, tuple]:
+    telemetry=None,
+) -> LoopState | tuple:
     """One EVENT-only micro-step: lanes in M_EVENT mode pop + handle one
     event (with the full shared tail); other lanes no-op. With `record`,
     also returns the `(reward, dt, reset)` triple (zeroed for non-event
-    lanes, which are untouched).
+    lanes, which are untouched). With `telemetry`, counters advance for
+    live event-mode lanes only and the return gains a trailing
+    telemetry element.
 
     The point is cost amortization under vmap: a full `micro_step` pays
     for all three mode branches on every lane (batched `lax.switch`
@@ -623,19 +688,24 @@ def event_micro_step(
     ("event burst") advances event-heavy lanes at a fraction of the cost;
     per-lane semantics are unchanged because event processing is exactly
     the M_EVENT path and non-event lanes are untouched."""
+    track = telemetry is not None
     is_event = ls.mode == M_EVENT
     _, k_reset = jax.random.split(rng)
 
     ls0 = ls.replace(mode=_i32(M_EVENT))  # pre-bulk state for the tail
     if event_bulk:
-        env_b, nb = _bulk_cycle_chain(
+        env_b, nb, nb_rel, nb_rdy = _bulk_cycle_chain(
             params, bank, ls.env, is_event, bulk_events, bulk_cycles,
         )
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
         pop_on = is_event & _fused_pop_gate(env_b, nb)
     else:
+        nb = _i32(0)
+        nb_rel = nb_rdy = nb
         pop_on = is_event
-    st, rk, rj, rs, arg, quirk = _pop_event(params, ls.env, pop_on)
+    st, rk, rj, rs, arg, quirk, popped, ev_kind = _pop_event(
+        params, ls.env, pop_on
+    )
     ls_ev = ls.replace(mode=_i32(M_EVENT), env=st)
     out = _finish_micro_step(
         params, bank, ls0, ls_ev,
@@ -644,18 +714,36 @@ def event_micro_step(
     )
     if record:
         out, (rw, dt, rs_) = out
+    if track:
+        was_done = (
+            ls0.env.all_jobs_complete
+            | (ls0.env.wall_time >= ls0.env.time_limit)
+        )
+        gate = is_event & ~was_done
+        pop_live = popped & gate
+        telemetry = _tm_add(
+            telemetry,
+            event_steps=gate,
+            loop_iters=jnp.where(gate, nb + popped.astype(_i32), 0),
+            bulk_relaunch_events=jnp.where(gate, nb_rel, 0),
+            bulk_ready_events=jnp.where(gate, nb_rdy, 0),
+            ev_job_arrival=pop_live & (ev_kind == EV_JOB_ARRIVAL),
+            ev_task_finished=pop_live & (ev_kind == EV_TASK_FINISHED),
+            ev_exec_ready=pop_live & (ev_kind == EV_EXECUTOR_READY),
+        )
     # non-event lanes are untouched (their rng/state must not advance)
     final = jax.tree_util.tree_map(
         lambda a, b: jnp.where(is_event, a, b), out, ls
     )
     if record:
         zero = jnp.float32(0.0)
-        return final, (
+        rec_tail = (
             jnp.where(is_event, rw, zero),
             jnp.where(is_event, dt, zero),
             is_event & rs_,
         )
-    return final
+        return (final, rec_tail, telemetry) if track else (final, rec_tail)
+    return (final, telemetry) if track else final
 
 
 def run_flat(
@@ -673,30 +761,44 @@ def run_flat(
     fulfill_bulk: bool = False,
     bulk_cycles: int = 1,
     loop_state: LoopState | None = None,
-) -> LoopState:
+    telemetry=None,
+) -> LoopState | tuple:
     """Scan `num_groups` micro-step groups for one lane (vmap over
     lanes). Each group is one full micro-step plus `event_burst - 1`
     event-only sub-steps (see `event_micro_step`), i.e.
     `num_groups * event_burst` micro-steps in total. Pass `loop_state`
     (instead of a freshly-reset `state`) to continue a previous run —
-    bench chunks resume this way."""
+    bench chunks resume this way. With `telemetry` (an
+    `obs.Telemetry`), the counters ride the scan carry and the call
+    returns `(LoopState, Telemetry)`."""
     ls = init_loop_state(state) if loop_state is None else loop_state
+    track = telemetry is not None
 
     def body(carry, _):
-        ls, k = carry
+        if track:
+            ls, k, tm = carry
+        else:
+            (ls, k), tm = carry, None
         k, sub = jax.random.split(k)
-        ls = micro_step(
+        out = micro_step(
             params, bank, policy_fn, ls, sub, auto_reset,
             compute_levels, event_bulk, bulk_events, fulfill_bulk,
-            bulk_cycles,
+            bulk_cycles, telemetry=tm,
         )
+        ls, tm = out if track else (out, None)
         for _ in range(event_burst - 1):
             k, sub = jax.random.split(k)
-            ls = event_micro_step(
+            out = event_micro_step(
                 params, bank, ls, sub, auto_reset, event_bulk,
-                bulk_events, bulk_cycles,
+                bulk_events, bulk_cycles, telemetry=tm,
             )
-        return (ls, k), None
+            ls, tm = out if track else (out, None)
+        return ((ls, k, tm) if track else (ls, k)), None
 
+    if track:
+        (ls, _, telemetry), _ = lax.scan(
+            body, (ls, rng, telemetry), None, length=num_groups
+        )
+        return ls, telemetry
     (ls, _), _ = lax.scan(body, (ls, rng), None, length=num_groups)
     return ls

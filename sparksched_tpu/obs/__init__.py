@@ -1,0 +1,26 @@
+"""Observability subsystem: on-device telemetry counters, structured
+JSONL run logs, and legible device traces.
+
+Three parts (ISSUE 2 tentpole), each usable on its own:
+
+- `telemetry`: a small integer `Telemetry` pytree threaded (optionally)
+  through `env/core.py`'s per-decision event loop and
+  `env/flat_loop.py`'s micro-step engine — pure i32 adds inside jit,
+  summarized on host once per iteration (`summarize`). Counts per-lane
+  step types (DECIDE / FULFILL / EVENT), event pops by kind, bulk-pass
+  consumption, fulfillments and commitment rounds, and the while-loop
+  iteration counts from which the straggler ratio (max/mean over lanes)
+  is *measured* rather than inferred from A/B steps/s pairs.
+- `runlog`: a JSONL event stream per run under `artifacts/` — timed
+  spans, telemetry summaries, per-iteration training stats, and JIT
+  recompile events via `jax.monitoring` hooks. The default sink the
+  trainer writes to (TensorBoard stays available as a mirror).
+- `tracing`: named `annotate(...)` scopes (jax.named_scope +
+  jax.profiler.TraceAnnotation) around the GNN eval, the env
+  micro-step, the collection scatter and the PPO update, so a captured
+  Perfetto trace carries those phase labels.
+"""
+
+from .runlog import RunLog, emit  # noqa: F401
+from .telemetry import Telemetry, summarize, telemetry_zeros  # noqa: F401
+from .tracing import annotate  # noqa: F401

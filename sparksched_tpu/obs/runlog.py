@@ -1,0 +1,267 @@
+"""Structured JSONL run log.
+
+One file per run under `artifacts/`, one JSON object per line — the
+machine-readable replacement for the trainer's ad-hoc stdout lines and
+the print-based profiler reports. Every record carries `ev` (the event
+kind) and `t` (unix seconds); the kinds the trainer/bench write:
+
+- `run_start` / `run_end`: run metadata (config summary, totals)
+- `span`: a timed host-side phase (`name`, `secs`, e.g. per-iteration
+  collect/update)
+- `scalars`: per-iteration training stats (the TensorBoard mirror —
+  identical keys/values to what `add_scalar` receives)
+- `telemetry`: an engine-telemetry summary (`obs.telemetry.summarize`)
+- `jit_compile` / `jit_compile_detail`: JIT (re)compilation events via
+  `jax.monitoring` duration hooks plus the dispatch logger (the latter
+  names WHICH function was traced/compiled)
+
+Readers: `PERF.md` "Reading a run" documents the schema; a runlog is
+greppable (`grep '"ev": "telemetry"' run.jsonl | tail -1`) and loads
+with one `json.loads` per line.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import os.path as osp
+import sys
+import threading
+import time
+import weakref
+from typing import Any
+
+# sanctioned console sink: the lint tier forbids bare `print(` inside
+# sparksched_tpu/ outside renderer.py, so host-loop progress lines go
+# through here (stdout, line-flushed — same observable behavior as the
+# print(..., flush=True) calls this replaces)
+
+
+def emit(msg: str) -> None:
+    sys.stdout.write(msg + "\n")
+    sys.stdout.flush()
+
+
+_CREATE_COUNTER = 0
+
+
+def _json_safe(v: Any) -> Any:
+    """Best-effort scalarization: numpy/jax scalars -> python numbers,
+    everything non-serializable -> str."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    try:
+        import numpy as np
+
+        if isinstance(v, np.ndarray) and v.ndim == 0:
+            v = v.item()
+        if isinstance(v, (np.integer,)):
+            return int(v)
+        if isinstance(v, (np.floating,)):
+            return float(v)
+    except Exception:
+        pass
+    if hasattr(v, "item"):
+        try:
+            return _json_safe(v.item())
+        except Exception:
+            pass
+    return str(v)
+
+
+class RunLog:
+    """Append-only JSONL writer (thread-safe; the JIT hooks fire from
+    whatever thread compiles)."""
+
+    def __init__(self, path: str, echo: bool = False) -> None:
+        os.makedirs(osp.dirname(osp.abspath(path)), exist_ok=True)
+        self.path = path
+        self.echo = echo
+        self._lock = threading.Lock()
+        self._fp = open(path, "a")
+        self._closed = False
+
+    @classmethod
+    def create(cls, artifacts_dir: str, name: str | None = None,
+               echo: bool = False) -> "RunLog":
+        """Open `artifacts_dir/runlog/<name>.jsonl`. The default name
+        carries pid + a process-local counter on top of the timestamp
+        so two runs started within the same second (back-to-back tests,
+        quick A/B scripts) never interleave into one file — RunLog
+        appends, and the schema promises one run per file."""
+        if name is None:
+            global _CREATE_COUNTER
+            _CREATE_COUNTER += 1
+            name = (
+                f"run-{int(time.time())}-{os.getpid()}-{_CREATE_COUNTER}"
+            )
+        return cls(
+            osp.join(artifacts_dir, "runlog", f"{name}.jsonl"), echo=echo
+        )
+
+    # -- record writers ----------------------------------------------------
+
+    def write(self, ev: str, **fields: Any) -> None:
+        if self._closed:
+            return
+        rec = {"ev": ev, "t": round(time.time(), 3)}
+        rec.update({k: _json_safe(v) for k, v in fields.items()})
+        line = json.dumps(rec)
+        with self._lock:
+            if self._closed:
+                return
+            self._fp.write(line + "\n")
+            self._fp.flush()
+        if self.echo:
+            emit(line)
+
+    def span(self, name: str, **fields: Any) -> "_Span":
+        """Context manager timing a block; writes one `span` record with
+        `secs` on exit (exception-safe — the record is written either
+        way, with `error` set when the block raised)."""
+        return _Span(self, name, fields)
+
+    def span_event(self, name: str, secs: float, **fields: Any) -> None:
+        """A span measured elsewhere (e.g. by `trainers.Profiler`)."""
+        self.write("span", name=name, secs=round(float(secs), 4), **fields)
+
+    def scalars(self, iteration: int, stats: dict[str, Any]) -> None:
+        self.write("scalars", iteration=int(iteration), **stats)
+
+    def telemetry(self, summary: dict[str, Any],
+                  iteration: int | None = None, **fields: Any) -> None:
+        if iteration is not None:
+            fields["iteration"] = int(iteration)
+        self.write("telemetry", summary=summary, **fields)
+
+    # -- JIT recompile hooks ----------------------------------------------
+
+    def install_jit_hooks(self) -> None:
+        """Record JIT (re)compilations into this runlog — see
+        `_install_global_jit_listener`. Idempotent per process; multiple
+        runlogs each receive the events while open."""
+        _install_global_jit_listener()
+        _ACTIVE_RUNLOGS.add(self)
+
+    def close(self, **fields: Any) -> None:
+        if self._closed:
+            return
+        self.write("run_end", **fields)
+        with self._lock:
+            self._closed = True
+            self._fp.close()
+        _ACTIVE_RUNLOGS.discard(self)
+
+    def __enter__(self) -> "RunLog":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.close()
+
+
+class _Span:
+    def __init__(self, log: RunLog, name: str, fields: dict) -> None:
+        self._log = log
+        self._name = name
+        self._fields = fields
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.elapsed = time.perf_counter() - self._t0
+        fields = dict(self._fields)
+        if exc_type is not None:
+            fields["error"] = exc_type.__name__
+        self._log.span_event(self._name, self.elapsed, **fields)
+
+
+# ---------------------------------------------------------------------------
+# process-global JIT compile listener
+#
+# jax.monitoring listeners cannot be individually unregistered, so ONE
+# listener is installed per process and fans out to the currently-open
+# runlogs (a WeakSet: a garbage-collected runlog stops receiving without
+# explicit teardown). The duration events name the compile PHASE
+# (/jax/core/compile/...) but not the function; the dispatch logger's
+# "Finished tracing + transforming <fun> ..." lines carry the name, so a
+# DEBUG handler on that logger records WHICH function recompiled.
+# ---------------------------------------------------------------------------
+
+_ACTIVE_RUNLOGS: "weakref.WeakSet[RunLog]" = weakref.WeakSet()
+_HOOKS_INSTALLED = False
+# compiles shorter than this are not recorded: the hundreds of trivial
+# broadcast/convert compiles at process start would bloat every runlog,
+# while any recompile worth investigating (a shape leak, a cache miss
+# mid-run) is orders of magnitude above it
+JIT_MIN_SECS = float(os.environ.get("RUNLOG_JIT_MIN_SECS", "0.05"))
+
+
+def _fanout(ev: str, **fields: Any) -> None:
+    for rl in list(_ACTIVE_RUNLOGS):
+        try:
+            rl.write(ev, **fields)
+        except Exception:
+            pass  # a closed/broken sink must never break compilation
+
+
+class _DispatchLogHandler(logging.Handler):
+    def emit(self, record: logging.LogRecord) -> None:  # noqa: A003
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        # "Finished XLA compilation of <fun> in <secs> sec" — the only
+        # record that names WHICH function compiled; tracing/MLIR lines
+        # are redundant with the duration events
+        if not msg.startswith("Finished XLA compilation"):
+            return
+        try:
+            secs = float(msg.rsplit(" in ", 1)[1].split()[0])
+        except (IndexError, ValueError):
+            secs = None
+        if secs is not None and secs < JIT_MIN_SECS:
+            return
+        _fanout("jit_compile_detail", msg=msg, secs=secs)
+
+
+def _install_global_jit_listener() -> None:
+    global _HOOKS_INSTALLED
+    if _HOOKS_INSTALLED:
+        return
+    import jax
+
+    def _on_duration(event: str, duration: float, **kw: Any) -> None:
+        if "compile" in event and float(duration) >= JIT_MIN_SECS:
+            _fanout("jit_compile", event=event,
+                    secs=round(float(duration), 4),
+                    **{k: _json_safe(v) for k, v in kw.items()})
+
+    jax.monitoring.record_event_duration_secs  # attr check before hook
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+    # jax's per-compile "Finished ..." lines (the only place the
+    # compiled FUNCTION is named) log at DEBUG; lowering the logger to
+    # DEBUG would also spill every line to a basicConfig'd root logger,
+    # so propagation is cut and records at the logger's previous
+    # effective level (warnings) are re-emitted to root by hand.
+    logger = logging.getLogger("jax._src.dispatch")
+    prev_effective = logger.getEffectiveLevel()
+
+    class _Forward(logging.Handler):
+        def emit(self, record: logging.LogRecord) -> None:  # noqa: A003
+            if record.levelno >= max(prev_effective, logging.WARNING):
+                logging.getLogger().handle(record)
+
+    logger.addHandler(_DispatchLogHandler(level=logging.DEBUG))
+    logger.addHandler(_Forward(level=logging.DEBUG))
+    logger.setLevel(logging.DEBUG)
+    logger.propagate = False
+    _HOOKS_INSTALLED = True

@@ -1,0 +1,166 @@
+"""On-device telemetry counters for both rollout engines.
+
+A `Telemetry` is a tiny pytree of i32 scalars (one per lane when the
+engine is vmapped) threaded through the hot loops as *pure adds inside
+jit* — no host callbacks, no side effects, a handful of scalar ops per
+iteration against loop bodies of thousands. Both engines take it as an
+optional argument and are bit-identical no-ops when it is omitted
+(`telemetry=None` skips the threading entirely, so the off path costs
+zero).
+
+Counter semantics per engine:
+
+- `env/core.py` (per-decision `step`): `decide_steps` counts live step
+  calls (one per policy commitment), `commit_rounds` finished rounds,
+  `loop_iters` the `_resume_simulation` while-loop body iterations —
+  under vmap the loop batching masks the carry for lanes whose cond is
+  false, so each lane counts exactly ITS iteration count and the
+  straggler tax (max/mean over lanes) is measured, not inferred.
+  `event_steps` / `ev_*` count single event pops by kind;
+  `bulk_relaunch_events` / `bulk_ready_events` the events consumed by
+  the vectorized passes; `fulfill_steps` / `bulk_fulfill_hits` the
+  one-at-a-time vs bulk-prefix fulfillments.
+- `env/flat_loop.py` (micro-step engine): `decide_steps` /
+  `fulfill_steps` / `event_steps` count live micro-steps by entry mode
+  (the micro-step composition), `loop_iters` the events consumed per
+  lane (pops + bulk passes) — the lane-imbalance quantity the flat
+  engine absorbs without stalling.
+
+Cross-engine invariant (the parity test): on a deterministic workload
+the two engines process the same trajectory, so `decide_steps`, the
+per-kind event totals (single pops + the bulk pass attributable to that
+kind) and the fulfillment totals (`fulfill_steps + bulk_fulfill_hits`)
+agree exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+_i32 = jnp.int32
+
+
+class Telemetry(struct.PyTreeNode):
+    """Per-lane engine counters (i32 scalars; vmapped engines add a
+    leading lane axis). See the module docstring for per-engine
+    semantics."""
+
+    decide_steps: jnp.ndarray  # policy commitments on live lanes
+    fulfill_steps: jnp.ndarray  # one-at-a-time fulfillments
+    event_steps: jnp.ndarray  # single event pops / EVENT micro-steps
+    loop_iters: jnp.ndarray  # while-loop iters (core) / events (flat)
+    ev_job_arrival: jnp.ndarray  # single pops by kind
+    ev_task_finished: jnp.ndarray
+    ev_exec_ready: jnp.ndarray
+    bulk_relaunch_events: jnp.ndarray  # TASK_FINISHED via _bulk_relaunch
+    bulk_ready_events: jnp.ndarray  # EXECUTOR_READY via _bulk_ready
+    bulk_fulfill_hits: jnp.ndarray  # candidates via _bulk_fulfill
+    commit_rounds: jnp.ndarray  # finished commitment rounds
+
+
+def telemetry_zeros() -> Telemetry:
+    z = jnp.zeros((), _i32)
+    return Telemetry(*([z] * len(Telemetry.__dataclass_fields__)))
+
+
+def telemetry_zeros_like(batch_shape: tuple[int, ...]) -> Telemetry:
+    """Zeros with a leading batch shape on every counter — the starting
+    value for vmapped engines (one counter set per lane)."""
+    z = jnp.zeros(batch_shape, _i32)
+    return Telemetry(*([z] * len(Telemetry.__dataclass_fields__)))
+
+
+def _count(x) -> bool:
+    """i32-cast helper for bool increments."""
+    return x.astype(_i32) if hasattr(x, "astype") else _i32(x)
+
+
+def add(tm: Telemetry | None, **deltas: Any) -> Telemetry | None:
+    """`tm.replace(field=field + delta, ...)` with bool deltas cast to
+    i32; passes None through so call sites stay one-liners."""
+    if tm is None:
+        return None
+    return tm.replace(
+        **{k: getattr(tm, k) + _count(v) for k, v in deltas.items()}
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side summary (once per iteration / bench row)
+# ---------------------------------------------------------------------------
+
+
+def subtract(tm: Telemetry, prev) -> Telemetry:
+    """Counter delta since a `jax.device_get` snapshot `prev` (numpy
+    pytree) — bench windows report the timed span, not the warmup."""
+    return jax.tree_util.tree_map(lambda a, b: a - b, tm, prev)
+
+
+def summarize(tm: Telemetry, prev=None) -> dict[str, Any]:
+    """Host-side summary dict of a (possibly vmapped) Telemetry.
+
+    Reports totals pooled over lanes, the micro-step composition
+    (decide/fulfill/event fractions), per-kind event totals including
+    the bulk passes, events and micro-steps per decision, and the
+    straggler ratio max/mean over lanes of `loop_iters` — for the core
+    engine that is the measured while-loop straggler tax the flat
+    engine exists to remove; for the flat engine it is the event-count
+    imbalance absorbed without stalling. `prev` (a `jax.device_get`
+    snapshot) windows the summary to the counts since the snapshot.
+    """
+    import numpy as np
+
+    t = jax.device_get(tm)
+    if prev is not None:
+        t = subtract(t, prev)
+
+    def tot(x) -> int:
+        return int(np.sum(np.asarray(x)))
+
+    decide = tot(t.decide_steps)
+    fulfill = tot(t.fulfill_steps)
+    event = tot(t.event_steps)
+    micro = decide + fulfill + event
+    li = np.asarray(t.loop_iters).ravel().astype(np.float64)
+    lanes = int(li.size)
+    mean_li = float(li.mean()) if lanes else 0.0
+    straggler = float(li.max() / mean_li) if mean_li > 0 else 1.0
+
+    events_by_kind = {
+        "job_arrival": tot(t.ev_job_arrival),
+        "task_finished": tot(t.ev_task_finished)
+        + tot(t.bulk_relaunch_events),
+        "executor_ready": tot(t.ev_exec_ready)
+        + tot(t.bulk_ready_events),
+    }
+    events_total = sum(events_by_kind.values())
+    frac = lambda n: round(n / micro, 4) if micro else 0.0  # noqa: E731
+    per_dec = lambda n: round(n / decide, 3) if decide else 0.0  # noqa: E731
+    return {
+        "lanes": lanes,
+        "decisions": decide,
+        "commit_rounds": tot(t.commit_rounds),
+        "micro_steps": micro,
+        "composition": {
+            "decide": frac(decide),
+            "fulfill": frac(fulfill),
+            "event": frac(event),
+        },
+        "events_by_kind": events_by_kind,
+        "events_total": events_total,
+        "events_per_decision": per_dec(events_total),
+        "micro_per_decision": per_dec(micro),
+        "bulk": {
+            "relaunch_events": tot(t.bulk_relaunch_events),
+            "ready_events": tot(t.bulk_ready_events),
+            "fulfill_hits": tot(t.bulk_fulfill_hits),
+        },
+        "fulfillments": fulfill + tot(t.bulk_fulfill_hits),
+        "loop_iters_mean": round(mean_li, 2),
+        "loop_iters_max": int(li.max()) if lanes else 0,
+        "straggler_ratio": round(straggler, 3),
+    }

@@ -1,0 +1,49 @@
+"""Named trace scopes so captured device traces are legible.
+
+`annotate(name)` combines the two annotation mechanisms a jitted JAX
+program needs for one Perfetto-readable label:
+
+- `jax.named_scope(name)`: active at TRACE time — prefixes the HLO
+  metadata of every op created inside the block, so the XLA device
+  timeline groups the phase's kernels under the name;
+- `jax.profiler.TraceAnnotation(name)`: active at RUN time on the host
+  thread — marks the dispatch span in the host track (useful around
+  un-jitted host phases like the trainer's collect/update calls).
+
+Entering both is cheap and safe in either context (a TraceAnnotation
+with no profiler running is a no-op; a named_scope outside tracing only
+touches a thread-local name stack), so call sites don't have to care
+which side of the jit boundary they are on. The phases the codebase
+labels: `decima/gnn` (GNN eval), `env/micro_step` (flat engine),
+`collect/scatter` (decision-buffer scatter), `train/ppo_update`.
+"""
+
+from __future__ import annotations
+
+
+class annotate:
+    """Context manager: `with annotate("decima/gnn"): ...`"""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._ns = None
+        self._ta = None
+
+    def __enter__(self) -> "annotate":
+        import jax
+
+        self._ns = jax.named_scope(self.name)
+        self._ns.__enter__()
+        try:
+            self._ta = jax.profiler.TraceAnnotation(self.name)
+            self._ta.__enter__()
+        except Exception:
+            self._ta = None  # profiler backend unavailable: scope only
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        try:
+            if self._ta is not None:
+                self._ta.__exit__(exc_type, exc_val, exc_tb)
+        finally:
+            self._ns.__exit__(exc_type, exc_val, exc_tb)

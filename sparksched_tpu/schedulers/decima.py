@@ -476,9 +476,12 @@ class DecimaScheduler(TrainableScheduler):
     # -- pure policy (vmap/scan-safe) -------------------------------------
     def policy(self, rng: jax.Array, obs: Observation, params=None,
                deterministic: bool = False):
+        from ..obs.tracing import annotate
+
         params = self.params if params is None else params
         f = self.features(obs)
-        stage_scores, exec_scores = self.net.apply(params, f)
+        with annotate("decima/gnn"):
+            stage_scores, exec_scores = self.net.apply(params, f)
         action, lgprob = sample_action(
             rng, stage_scores, exec_scores, f, deterministic
         )
@@ -526,10 +529,13 @@ class DecimaScheduler(TrainableScheduler):
         wall at the flagship 200-job/20-stage scale. Remat trades one
         recomputed forward for ~S x less live activation memory."""
 
+        from ..obs.tracing import annotate
+
         def one(f, a):
-            stage_scores, exec_scores = jax.checkpoint(
-                lambda p, ff: self.net.apply(p, ff)
-            )(params, f)
+            with annotate("decima/gnn"):
+                stage_scores, exec_scores = jax.checkpoint(
+                    lambda p, ff: self.net.apply(p, ff)
+                )(params, f)
             return evaluate_actions(
                 stage_scores, exec_scores, f, a, self.num_executors
             )

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..obs.tracing import annotate
 from ..schedulers.decima import DecimaAction
 from .rollout import Rollout, stored_to_observation
 from .trainer import CfgType, Trainer, TrainState
@@ -44,8 +45,10 @@ def _masked_mean(x, w, n):
 
 class PPO(Trainer):
     def __init__(self, agent_cfg: CfgType, env_cfg: CfgType,
-                 train_cfg: CfgType, mesh=None) -> None:
-        super().__init__(agent_cfg, env_cfg, train_cfg, mesh=mesh)
+                 train_cfg: CfgType, mesh=None,
+                 obs_cfg: CfgType | None = None) -> None:
+        super().__init__(agent_cfg, env_cfg, train_cfg, mesh=mesh,
+                         obs_cfg=obs_cfg)
         self.entropy_coeff = train_cfg.get("entropy_coeff", 0.0)
         self.clip_range = train_cfg.get("clip_range", 0.2)
         self.target_kl = train_cfg.get("target_kl", 0.01)
@@ -182,11 +185,12 @@ class PPO(Trainer):
         zero = jnp.float32(0.0)
         sums0 = {"policy_loss": zero, "entropy_loss": zero, "kl": zero,
                  "count": zero}
-        (params, opt_state, _, sums), _ = jax.lax.scan(
-            body,
-            (state.params, state.opt_state, jnp.bool_(False), sums0),
-            (mb_idx, mb_ok),
-        )
+        with annotate("train/ppo_update"):
+            (params, opt_state, _, sums), _ = jax.lax.scan(
+                body,
+                (state.params, state.opt_state, jnp.bool_(False), sums0),
+                (mb_idx, mb_ok),
+            )
         n = jnp.maximum(sums["count"], 1.0)
         stats = {
             "policy_loss": jnp.abs(sums["policy_loss"] / n),

@@ -38,6 +38,7 @@ from ..env.flat_loop import (
 )
 from ..env.observe import Observation, observe
 from ..env.state import EnvState
+from ..obs.tracing import annotate
 from ..workload.bank import WorkloadBank
 
 _i32 = jnp.int32
@@ -157,17 +158,35 @@ def collect_sync(
     rng: jax.Array,
     num_steps: int,
     state: EnvState,
-) -> Rollout:
+    telemetry=None,
+) -> Rollout | tuple:
     """One episode (from the given freshly-reset state), padded to
-    `num_steps` decisions (reference RolloutWorkerSync.collect_rollout)."""
+    `num_steps` decisions (reference RolloutWorkerSync.collect_rollout).
+    With `telemetry` (an `obs.Telemetry`), engine counters ride the scan
+    carry — rolled back on frozen (done) lanes — and the call returns
+    `(Rollout, Telemetry)`."""
+    track = telemetry is not None
 
     def body(carry, _):
-        st, k = carry
+        if track:
+            st, k, tm = carry
+        else:
+            (st, k), tm = carry, None
         k, k_pol = jax.random.split(k)
         obs = observe(params, st)
         done = st.terminated | st.truncated
         stage_idx, num_exec, aux = policy_fn(k_pol, obs)
-        nxt, reward, _, _ = core.step(params, bank, st, stage_idx, num_exec)
+        if track:
+            nxt, reward, _, _, tm2 = core.step(
+                params, bank, st, stage_idx, num_exec, telemetry=tm
+            )
+            tm = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(done, a, b), tm, tm2
+            )
+        else:
+            nxt, reward, _, _ = core.step(
+                params, bank, st, stage_idx, num_exec
+            )
         nxt = jax.tree_util.tree_map(
             lambda a, b: jnp.where(done, a, b), st, nxt
         )
@@ -184,13 +203,15 @@ def collect_sync(
             st.wall_time,
             ~done,
         )
-        return (nxt, k), rec
+        return ((nxt, k, tm) if track else (nxt, k)), rec
 
-    (final, _), (obs, stage_idx, job, kk, lgprob, reward, wt, valid) = (
-        lax.scan(body, (state, rng), None, length=num_steps)
+    carry0 = (state, rng, telemetry) if track else (state, rng)
+    carry, (obs, stage_idx, job, kk, lgprob, reward, wt, valid) = (
+        lax.scan(body, carry0, None, length=num_steps)
     )
+    final = carry[0]
     wall_times = jnp.concatenate([wt, final.wall_time[None]])
-    return Rollout(
+    ro = Rollout(
         obs=obs,
         stage_idx=stage_idx,
         job_idx=job,
@@ -203,6 +224,7 @@ def collect_sync(
         final_state=final,
         final_reset_count=jnp.int32(0),
     )
+    return (ro, carry[2]) if track else ro
 
 
 @partial(jax.jit, static_argnums=(0, 2, 4))
@@ -217,11 +239,14 @@ def collect_async(
     seq_base: jax.Array | None = None,
     lane_salt: jnp.ndarray | int = 0,
     reset_count: jnp.ndarray | int = 0,
-) -> Rollout:
+    telemetry=None,
+) -> Rollout | tuple:
     """Fixed sim-time budget with persistent envs and auto-reset (reference
     RolloutWorkerAsync.collect_rollout:171-206). `wall_times` are *elapsed*
     times within the iteration, continuing across resets. Steps after the
-    budget is exhausted are masked.
+    budget is exhausted are masked. With `telemetry`, counters ride the
+    scan carry (rolled back on budget-frozen lanes) and the call returns
+    `(Rollout, Telemetry)`.
 
     Mid-scan resets draw the new episode from
     ``fold_in(seq_base, reset_count)`` — so lanes that share `seq_base`
@@ -232,6 +257,7 @@ def collect_async(
     de-correlates the per-lane stochastic stream within a group
     (core.reset_pair's seq/lane split). When `seq_base` is None (ad-hoc
     use outside a trainer), `rng` stands in for it."""
+    track = telemetry is not None
     rollout_duration = jnp.float32(rollout_duration)
     if seq_base is None:
         seq_base = rng
@@ -239,14 +265,25 @@ def collect_async(
     reset_count = jnp.asarray(reset_count, _i32)
 
     def body(carry, _):
-        st, k, elapsed, rc = carry
+        if track:
+            st, k, elapsed, rc, tm = carry
+        else:
+            (st, k, elapsed, rc), tm = carry, None
         k, k_pol = jax.random.split(k)
         obs = observe(params, st)
         over = elapsed >= rollout_duration
         stage_idx, num_exec, aux = policy_fn(k_pol, obs)
-        nxt, reward, term, trunc = core.step(
-            params, bank, st, stage_idx, num_exec
-        )
+        if track:
+            nxt, reward, term, trunc, tm2 = core.step(
+                params, bank, st, stage_idx, num_exec, telemetry=tm
+            )
+            tm = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(over, a, b), tm, tm2
+            )
+        else:
+            nxt, reward, term, trunc = core.step(
+                params, bank, st, stage_idx, num_exec
+            )
         new_elapsed = elapsed + (nxt.wall_time - st.wall_time)
         done = term | trunc
 
@@ -281,16 +318,22 @@ def collect_async(
             ~over,
             did_reset,
         )
-        return (nxt2, k, new_elapsed, new_rc), rec
+        carry = (
+            (nxt2, k, new_elapsed, new_rc, tm)
+            if track
+            else (nxt2, k, new_elapsed, new_rc)
+        )
+        return carry, rec
 
-    (final, _, elapsed, final_rc), (
+    carry0 = (state, rng, jnp.float32(0.0), reset_count)
+    if track:
+        carry0 = carry0 + (telemetry,)
+    carry, (
         obs, stage_idx, job, kk, lgprob, reward, wt, valid, resets
-    ) = lax.scan(
-        body, (state, rng, jnp.float32(0.0), reset_count), None,
-        length=num_steps,
-    )
+    ) = lax.scan(body, carry0, None, length=num_steps)
+    final, elapsed, final_rc = carry[0], carry[2], carry[3]
     wall_times = jnp.concatenate([wt, elapsed[None]])
-    return Rollout(
+    ro = Rollout(
         obs=obs,
         stage_idx=stage_idx,
         job_idx=job,
@@ -303,6 +346,7 @@ def collect_async(
         final_state=final,
         final_reset_count=final_rc,
     )
+    return (ro, carry[4]) if track else ro
 
 
 def vmap_collect(collect_fn, params, bank, policy_fn, rngs, num_steps,
@@ -395,6 +439,7 @@ def _flat_collect(
     reset_fn,
     rollout_duration,
     use_elapsed: bool,
+    telemetry=None,
 ):
     """Shared flat-engine collection scan for one lane (vmap over lanes).
 
@@ -411,7 +456,12 @@ def _flat_collect(
     mid-phase) belong to the previous chunk's final decision, which was
     already consumed; they are dropped together with their `dt`, which
     keeps the (reward, dt) pairing the returns/average-job estimators
-    rely on consistent."""
+    rely on consistent.
+
+    With `telemetry`, engine counters ride the scan carry (rolled back
+    on frozen lanes) and the returned tuple gains a trailing
+    Telemetry."""
+    track = telemetry is not None
     T = num_steps
     zs = _zero_stored(params)
     buf0 = _FlatBuf(
@@ -428,7 +478,11 @@ def _flat_collect(
     )
 
     def body(carry, _):
-        ls, k, t_ref, elapsed, ndec, buf = carry
+        if track:
+            ls, k, t_ref, elapsed, ndec, buf, tm = carry
+        else:
+            (ls, k, t_ref, elapsed, ndec, buf), tm = carry, None
+        tm_frozen = tm
         k, sub = jax.random.split(k)
         env0 = ls.env
         wall0 = env0.wall_time
@@ -438,11 +492,13 @@ def _flat_collect(
         if rollout_duration is not None:
             over = over | (elapsed >= rollout_duration)
 
-        ls2, rec = micro_step(
+        out = micro_step(
             params, bank, policy_fn, ls, sub, auto_reset, True,
             event_bulk, bulk_events, fulfill_bulk, bulk_cycles,
             record=True, reset_fn=reset_fn, t_ref=t_ref,
+            telemetry=tm,
         )
+        (ls2, rec, tm) = out if track else (out + (None,))
         # advance the discount reference BEFORE the burst sub-steps: with
         # fulfill_bulk a round-finishing DECIDE micro-step jumps straight
         # to M_EVENT, so this group's own sub-steps already advance time
@@ -451,10 +507,14 @@ def _flat_collect(
         reward, dt, reset = rec.reward, rec.dt, rec.reset
         for _ in range(event_burst - 1):
             k, sub = jax.random.split(k)
-            ls2, (rw, dd, rr) = event_micro_step(
+            out = event_micro_step(
                 params, bank, ls2, sub, auto_reset, event_bulk,
                 bulk_events, bulk_cycles,
                 record=True, reset_fn=reset_fn, t_ref=t_ref,
+                telemetry=tm,
+            )
+            (ls2, (rw, dd, rr), tm) = (
+                out if track else (out + (None,))
             )
             reward = reward + rw
             dt = dt + dd
@@ -464,6 +524,10 @@ def _flat_collect(
         ls2 = jax.tree_util.tree_map(
             lambda a, b: jnp.where(over, a, b), ls, ls2
         )
+        if track:
+            tm = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(over, a, b), tm_frozen, tm
+            )
         zero = jnp.float32(0.0)
         reward = jnp.where(over, zero, reward)
         dt = jnp.where(over, zero, dt)
@@ -472,42 +536,49 @@ def _flat_collect(
 
         # decision-slot scatter (mode="drop" discards non-decide steps
         # and buffer overflow alike)
-        slot = jnp.where(dec & (ndec < T), ndec, T)
-        stored = store_obs(rec.obs, env0)
-        buf = buf.replace(
-            obs=jax.tree_util.tree_map(
-                lambda b, v: b.at[slot].set(v, mode="drop"),
-                buf.obs, stored,
-            ),
-            stage_idx=buf.stage_idx.at[slot].set(
-                rec.stage_idx, mode="drop"
-            ),
-            job_idx=buf.job_idx.at[slot].set(rec.job_idx, mode="drop"),
-            num_exec_k=buf.num_exec_k.at[slot].set(
-                rec.num_exec_k, mode="drop"
-            ),
-            lgprob=buf.lgprob.at[slot].set(rec.lgprob, mode="drop"),
-            walls=buf.walls.at[slot].set(
-                elapsed if use_elapsed else wall0, mode="drop"
-            ),
-        )
-        ndec2 = ndec + dec.astype(_i32)
-        # micro-rewards belong to the most recent decision's span
-        rslot = jnp.where((ndec2 > 0) & (ndec2 <= T), ndec2 - 1, T)
-        buf = buf.replace(
-            reward=buf.reward.at[rslot].add(reward, mode="drop"),
-            resets=buf.resets.at[rslot].max(
-                reset.astype(_i32), mode="drop"
-            ),
-        )
-        return (ls2, k, t_ref, elapsed + dt, ndec2, buf), None
+        with annotate("collect/scatter"):
+            slot = jnp.where(dec & (ndec < T), ndec, T)
+            stored = store_obs(rec.obs, env0)
+            buf = buf.replace(
+                obs=jax.tree_util.tree_map(
+                    lambda b, v: b.at[slot].set(v, mode="drop"),
+                    buf.obs, stored,
+                ),
+                stage_idx=buf.stage_idx.at[slot].set(
+                    rec.stage_idx, mode="drop"
+                ),
+                job_idx=buf.job_idx.at[slot].set(
+                    rec.job_idx, mode="drop"
+                ),
+                num_exec_k=buf.num_exec_k.at[slot].set(
+                    rec.num_exec_k, mode="drop"
+                ),
+                lgprob=buf.lgprob.at[slot].set(rec.lgprob, mode="drop"),
+                walls=buf.walls.at[slot].set(
+                    elapsed if use_elapsed else wall0, mode="drop"
+                ),
+            )
+            ndec2 = ndec + dec.astype(_i32)
+            # micro-rewards belong to the most recent decision's span
+            rslot = jnp.where((ndec2 > 0) & (ndec2 <= T), ndec2 - 1, T)
+            buf = buf.replace(
+                reward=buf.reward.at[rslot].add(reward, mode="drop"),
+                resets=buf.resets.at[rslot].max(
+                    reset.astype(_i32), mode="drop"
+                ),
+            )
+        carry = (ls2, k, t_ref, elapsed + dt, ndec2, buf)
+        return (carry + (tm,) if track else carry), None
 
     carry0 = (
         ls, rng, ls.env.wall_time, jnp.float32(0.0), _i32(0), buf0
     )
-    (ls, _, _, elapsed, ndec, buf), _ = lax.scan(
-        body, carry0, None, length=micro_groups
-    )
+    if track:
+        carry0 = carry0 + (telemetry,)
+    carry, _ = lax.scan(body, carry0, None, length=micro_groups)
+    ls, elapsed, ndec, buf = carry[0], carry[3], carry[4], carry[5]
+    if track:
+        telemetry = carry[6]
 
     valid = jnp.arange(T) < jnp.minimum(ndec, T)
     final_t = elapsed if use_elapsed else ls.env.wall_time
@@ -525,7 +596,7 @@ def _flat_collect(
         final_state=ls.env,
         final_reset_count=ls.episodes,
     )
-    return ro, ls
+    return (ro, ls, telemetry) if track else (ro, ls)
 
 
 @partial(
@@ -542,6 +613,7 @@ def collect_flat_sync(
     rng: jax.Array,
     num_steps: int,
     state: EnvState,
+    telemetry=None,
     *,
     micro_groups: int,
     event_burst: int = 1,
@@ -549,21 +621,22 @@ def collect_flat_sync(
     bulk_events: int = 8,
     fulfill_bulk: bool = False,
     bulk_cycles: int = 1,
-) -> Rollout:
+) -> Rollout | tuple:
     """Flat-engine equivalent of `collect_sync`: one episode from the
     given freshly-reset state, micro-stepped with frozen lanes at episode
     end, padded to `num_steps` decisions. `micro_groups` bounds the scan
     (size it at ~3-4 micro-step groups per expected decision; a too-small
-    value truncates the episode exactly like a too-small `num_steps`)."""
-    ro, _ = _flat_collect(
+    value truncates the episode exactly like a too-small `num_steps`).
+    With `telemetry`, returns `(Rollout, Telemetry)`."""
+    out = _flat_collect(
         params, bank, policy_fn, rng, num_steps,
         init_loop_state(state), micro_groups,
         auto_reset=False, event_burst=event_burst, event_bulk=event_bulk,
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fn=None, rollout_duration=None,
-        use_elapsed=False,
+        use_elapsed=False, telemetry=telemetry,
     )
-    return ro
+    return (out[0], out[2]) if telemetry is not None else out[0]
 
 
 @partial(
@@ -584,6 +657,7 @@ def collect_flat_async(
     seq_base: jax.Array | None = None,
     lane_salt: jnp.ndarray | int = 0,
     reset_count: jnp.ndarray | int = 0,
+    telemetry=None,
     *,
     micro_groups: int,
     event_burst: int = 1,
@@ -591,7 +665,7 @@ def collect_flat_async(
     bulk_events: int = 8,
     fulfill_bulk: bool = False,
     bulk_cycles: int = 1,
-) -> tuple[Rollout, LoopState]:
+) -> tuple:
     """Flat-engine equivalent of `collect_async`: persistent lanes with a
     fixed sim-time budget per iteration and mid-scan auto-resets drawn
     from `fold_in(seq_base, reset_count + completed_episodes)` — the same
@@ -604,7 +678,8 @@ def collect_flat_async(
     as in `collect_async`. The budget check runs at micro-step-group
     granularity rather than `collect_async`'s decision granularity, and
     micro-rewards a resumed lane accrues before its first decision of the
-    chunk are dropped (see `_flat_collect`)."""
+    chunk are dropped (see `_flat_collect`). With `telemetry`, returns
+    `(Rollout, LoopState, Telemetry)`."""
     rollout_duration = jnp.float32(rollout_duration)
     if seq_base is None:
         seq_base = rng
@@ -620,12 +695,16 @@ def collect_flat_async(
             params, bank, seq_rng, jax.random.fold_in(seq_rng, lane_salt)
         )
 
-    ro, ls = _flat_collect(
+    out = _flat_collect(
         params, bank, policy_fn, rng, num_steps, loop_state, micro_groups,
         auto_reset=True, event_burst=event_burst, event_bulk=event_bulk,
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fn=reset_fn,
         rollout_duration=rollout_duration, use_elapsed=True,
+        telemetry=telemetry,
     )
+    ro, ls = out[0], out[1]
     ro = ro.replace(final_reset_count=reset_count + ls.episodes)
+    if telemetry is not None:
+        return ro, ls, out[2]
     return ro, ls

@@ -43,6 +43,8 @@ from flax import serialization, struct
 from .. import metrics
 from ..config import EnvParams, env_params_from_cfg
 from ..env import core
+from ..obs import RunLog, emit
+from ..obs.telemetry import summarize, telemetry_zeros_like
 from ..schedulers import TrainableScheduler, make_scheduler
 from ..workload import make_workload_bank
 from .baselines import group_baselines
@@ -109,7 +111,8 @@ class Trainer(abc.ABC):
     """Base trainer; subclasses implement the jitted `_update`."""
 
     def __init__(self, agent_cfg: CfgType, env_cfg: CfgType,
-                 train_cfg: CfgType, mesh=None) -> None:
+                 train_cfg: CfgType, mesh=None,
+                 obs_cfg: CfgType | None = None) -> None:
         # TPU-friendly rbg PRNG for the whole training program (the env
         # hot loop draws several keys per micro-step; see
         # config.use_fast_prng). Must run before any key is created.
@@ -170,6 +173,26 @@ class Trainer(abc.ABC):
         # programs, so this uses the jax.profiler-backed Profiler)
         self.profiling: bool = bool(train_cfg.get("profiling", False))
         self.profile_trace_dir = train_cfg.get("profile_trace_dir")
+
+        # observability block (top-level `obs:` YAML section):
+        #   runlog: true|false|path — JSONL event stream (spans, stats,
+        #     telemetry summaries, JIT recompiles) under artifacts/
+        #     (the default sink; TensorBoard stays a mirror)
+        #   telemetry: true — thread engine counters through the rollout
+        #     collectors and summarize once per iteration
+        #   trace_iteration: N — capture a labeled jax.profiler device
+        #     trace of (absolute) iteration N's collect+update
+        #   trace_dir: where that trace lands (default
+        #     artifacts/trace)
+        oc = dict(obs_cfg or {})
+        self.obs_runlog = oc.get("runlog", True)
+        self.obs_telemetry: bool = bool(oc.get("telemetry", False))
+        ti = oc.get("trace_iteration")
+        self.obs_trace_iteration = None if ti is None else int(ti)
+        self.obs_trace_dir: str = oc.get(
+            "trace_dir", osp.join(self.artifacts_dir, "trace")
+        )
+        self._runlog: RunLog | None = None
 
         # exactly one returns mode (reference trainer.py:63-74)
         assert ("reward_buff_cap" in train_cfg) ^ (
@@ -274,7 +297,7 @@ class Trainer(abc.ABC):
                 f"evenly over {mesh.size} devices"
             )
             self._collect_jit = jax.jit(
-                self._collect, out_shardings=(lanes, None)
+                self._collect, out_shardings=(lanes, None, None)
             )
             self._update_jit = jax.jit(
                 self._update, in_shardings=(None, lanes),
@@ -310,13 +333,18 @@ class Trainer(abc.ABC):
         return base * (final / base) ** frac
 
     def _collect(self, model_params, iteration: jnp.ndarray,
-                 rng: jax.Array, env_states) -> tuple[Rollout, Any]:
+                 rng: jax.Array, env_states) -> tuple[Rollout, Any, Any]:
         """One iteration's rollouts: [B]-vmapped scans. Seed layout mirrors
         the reference (trainer.py:268-271): lanes in the same sequence
-        group share the job-sequence key, refreshed per reset."""
+        group share the job-sequence key, refreshed per reset. Returns
+        `(rollout, env_states, telemetry)` — telemetry is a per-lane
+        `obs.Telemetry` when `obs: telemetry` is on, else None."""
         p, bank = self.params_env, self.bank
         G, R = self.num_sequences, self.num_rollouts
         master = jax.random.PRNGKey(self.seed)
+        telem0 = (
+            telemetry_zeros_like((G * R,)) if self.obs_telemetry else None
+        )
         if self.fixed_sequences:
             iteration = jnp.zeros_like(iteration)
 
@@ -357,42 +385,55 @@ class Trainer(abc.ABC):
                 lambda g: jax.random.fold_in(master, g)
             )(g_ids)
             lane_salts = (1000 + r_ids).astype(jnp.int32)
+            # telem0 is None or a per-lane Telemetry; vmap treats None
+            # as an empty pytree, so ONE vmapped call covers both modes
+            # (the collector's return shape switches on the Python-level
+            # None check at trace time)
+            track = telem0 is not None
             if flat:
-                ro, loop_states = jax.vmap(
-                    lambda k, s, sb, salt, rc: collect_flat_async(
+                out = jax.vmap(
+                    lambda k, s, sb, salt, rc, tm: collect_flat_async(
                         p, bank, policy_fn, k, self.rollout_steps, s,
-                        self.rollout_duration, sb, salt, rc,
+                        self.rollout_duration, sb, salt, rc, tm,
                         micro_groups=self.flat_micro_groups,
                         **self.flat_knobs,
                     )
-                )(pol_rngs, states, seq_bases, lane_salts, reset_counts)
-                return ro, (loop_states, ro.final_reset_count)
-            ro = jax.vmap(
-                lambda k, s, sb, salt, rc: collect_async(
-                    p, bank, policy_fn, k, self.rollout_steps, s,
-                    self.rollout_duration, sb, salt, rc,
+                )(pol_rngs, states, seq_bases, lane_salts,
+                  reset_counts, telem0)
+                ro, loop_states, telem = (
+                    out if track else (out + (None,))
                 )
-            )(pol_rngs, states, seq_bases, lane_salts, reset_counts)
-            return ro, (ro.final_state, ro.final_reset_count)
+                return ro, (loop_states, ro.final_reset_count), telem
+            out = jax.vmap(
+                lambda k, s, sb, salt, rc, tm: collect_async(
+                    p, bank, policy_fn, k, self.rollout_steps, s,
+                    self.rollout_duration, sb, salt, rc, tm,
+                )
+            )(pol_rngs, states, seq_bases, lane_salts, reset_counts,
+              telem0)
+            ro, telem = out if track else (out, None)
+            return ro, (ro.final_state, ro.final_reset_count), telem
         else:  # sync: fresh episode per iteration
             states = jax.vmap(
                 lambda s, l: core.reset_pair(p, bank, s, l)
             )(seq_rngs, lane_rngs)
+            track = telem0 is not None
             if flat:
-                ro = jax.vmap(
-                    lambda k, s: collect_flat_sync(
-                        p, bank, policy_fn, k, self.rollout_steps, s,
+                out = jax.vmap(
+                    lambda k, s, tm: collect_flat_sync(
+                        p, bank, policy_fn, k, self.rollout_steps, s, tm,
                         micro_groups=self.flat_micro_groups,
                         **self.flat_knobs,
                     )
-                )(pol_rngs, states)
+                )(pol_rngs, states, telem0)
             else:
-                ro = jax.vmap(
-                    lambda k, s: collect_sync(
-                        p, bank, policy_fn, k, self.rollout_steps, s
+                out = jax.vmap(
+                    lambda k, s, tm: collect_sync(
+                        p, bank, policy_fn, k, self.rollout_steps, s, tm
                     )
-                )(pol_rngs, states)
-            return ro, None
+                )(pol_rngs, states, telem0)
+            ro, telem = out if track else (out, None)
+            return ro, None, telem
 
     def _returns_and_baselines(self, state: TrainState, ro: Rollout):
         """Shared preprocessing (reference trainer.py:172-212)."""
@@ -432,30 +473,48 @@ class Trainer(abc.ABC):
         self._setup(fresh=resume_from is None)
         if resume_from:
             state = self.load_train_state(resume_from)
-            print(f"Resumed from {resume_from} at iteration "
-                  f"{int(state.iteration)}.", flush=True)
+            emit(f"Resumed from {resume_from} at iteration "
+                 f"{int(state.iteration)}.")
+            if self._runlog is not None:
+                self._runlog.write(
+                    "resume", path=resume_from,
+                    iteration=int(state.iteration),
+                )
         else:
             state = self.init_state()
         best: dict[str, Any] | None = None
         start = int(state.iteration)
+        sink = (
+            self._runlog.span_event if self._runlog is not None else None
+        )
 
         for i in range(start, start + self.num_iterations):
             state = state.replace(
                 rng=jax.random.fold_in(jax.random.PRNGKey(self.seed), i)
             )
-            trace_dir = (
-                self.profile_trace_dir if i == start else None
-            )
+            # device trace: the obs-block iteration (absolute) wins; the
+            # legacy profile_trace_dir traces the session's first
+            # iteration's collect as before
+            if i == self.obs_trace_iteration:
+                trace_dir = self.obs_trace_dir
+            elif i == start and self.profile_trace_dir:
+                trace_dir = self.profile_trace_dir
+            else:
+                trace_dir = None
             with Profiler(trace_dir, f"iter {i + 1} collect",
-                          quiet=not self.profiling) as p_col:
-                ro, self._env_states = self._collect_jit(
+                          quiet=not self.profiling, sink=sink) as p_col:
+                ro, self._env_states, telem = self._collect_jit(
                     state.params, state.iteration, state.rng,
                     self._env_states,
                 )
                 jax.block_until_ready(ro.reward)
+            trace_upd = (
+                self.obs_trace_dir if i == self.obs_trace_iteration
+                else None
+            )
             prev_params = state.params
-            with Profiler(None, f"iter {i + 1} update",
-                          quiet=not self.profiling) as p_upd:
+            with Profiler(trace_upd, f"iter {i + 1} update",
+                          quiet=not self.profiling, sink=sink) as p_upd:
                 state, stats = self._update_jit(state, ro)
                 jax.block_until_ready(state.params)
             state = state.replace(iteration=state.iteration + 1)
@@ -484,11 +543,21 @@ class Trainer(abc.ABC):
             }
             host_stats["collect_seconds"] = p_col.elapsed
             host_stats["update_seconds"] = p_upd.elapsed
+            if telem is not None:
+                tsum = summarize(telem)
+                if self._runlog is not None:
+                    self._runlog.telemetry(tsum, iteration=i)
+                host_stats["straggler_ratio"] = tsum["straggler_ratio"]
+                host_stats["micro_per_decision"] = tsum[
+                    "micro_per_decision"
+                ]
+                host_stats["events_per_decision"] = tsum[
+                    "events_per_decision"
+                ]
             self._write_stats(i, host_stats | roll_stats)
-            print(
+            emit(
                 f"Iteration {i + 1} complete. Avg. # jobs: "
-                f"{avg_num_jobs:.3f}",
-                flush=True,
+                f"{avg_num_jobs:.3f}"
             )
         self._cleanup(state)
         return state
@@ -527,11 +596,44 @@ class Trainer(abc.ABC):
         if fresh:
             shutil.rmtree(self.checkpointing_dir, ignore_errors=True)
         os.makedirs(self.checkpointing_dir, exist_ok=True)
+        if self.obs_runlog and self._runlog is None:
+            if isinstance(self.obs_runlog, str):
+                self._runlog = RunLog(self.obs_runlog)
+            else:
+                self._runlog = RunLog.create(self.artifacts_dir)
+            self._runlog.install_jit_hooks()
+            self._runlog.write(
+                "run_start",
+                trainer=type(self).__name__,
+                num_iterations=self.num_iterations,
+                num_envs=self.num_envs,
+                rollout_steps=self.rollout_steps,
+                rollout_engine=self.rollout_engine,
+                telemetry=self.obs_telemetry,
+                seed=self.seed,
+            )
         self._tb = None
         if self.use_tensorboard:
-            from torch.utils.tensorboard import SummaryWriter
-
-            self._tb = SummaryWriter(osp.join(self.artifacts_dir, "tb"))
+            # a heavy torch dependency in a JAX repo: degrade to the
+            # JSONL runlog (the default sink) instead of crashing when
+            # torch/tensorboard is absent
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+            except ImportError as e:
+                emit(
+                    "use_tensorboard: torch.utils.tensorboard is "
+                    f"unavailable ({e}); stats go to the JSONL runlog "
+                    "instead"
+                    + (
+                        f" ({self._runlog.path})"
+                        if self._runlog is not None
+                        else " (enable it via the obs: config block)"
+                    )
+                )
+            else:
+                self._tb = SummaryWriter(
+                    osp.join(self.artifacts_dir, "tb")
+                )
 
     def _cleanup(self, state: TrainState) -> None:
         if self._tb is not None:
@@ -541,7 +643,10 @@ class Trainer(abc.ABC):
         self.save_train_state(
             state, osp.join(self.artifacts_dir, "train_state.msgpack")
         )
-        print("\nTraining complete.", flush=True)
+        if self._runlog is not None:
+            self._runlog.close(iteration=int(state.iteration))
+            self._runlog = None
+        emit("\nTraining complete.")
 
     def _checkpoint(self, i: int, best: dict[str, Any],
                     state: TrainState) -> None:
@@ -600,6 +705,10 @@ class Trainer(abc.ABC):
                 ) from e
 
     def _write_stats(self, i: int, stats: dict[str, float]) -> None:
+        """Per-iteration scalars: runlog JSONL (default sink) + the
+        TensorBoard mirror when enabled — identical keys/values."""
+        if self._runlog is not None:
+            self._runlog.scalars(i, stats)
         if self._tb is None:
             return
         for k, v in stats.items():
@@ -607,7 +716,9 @@ class Trainer(abc.ABC):
 
 
 def make_trainer(cfg: CfgType) -> Trainer:
-    """String-keyed factory (reference trainers/__init__.py:7-13)."""
+    """String-keyed factory (reference trainers/__init__.py:7-13); the
+    optional top-level `obs:` YAML section configures the observability
+    block (runlog / telemetry / trace capture)."""
     from .ppo import PPO
     from .vpg import VPG
 
@@ -615,4 +726,6 @@ def make_trainer(cfg: CfgType) -> Trainer:
     name = cfg["trainer"]["trainer_cls"]
     if name not in registry:
         raise ValueError(f"'{name}' is not a valid trainer.")
-    return registry[name](cfg["agent"], cfg["env"], cfg["trainer"])
+    return registry[name](
+        cfg["agent"], cfg["env"], cfg["trainer"], obs_cfg=cfg.get("obs")
+    )

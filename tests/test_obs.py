@@ -1,0 +1,268 @@
+"""Observability subsystem (sparksched_tpu/obs): runlog JSONL schema,
+telemetry summaries, profiler trace hygiene, the TensorBoard fallback,
+and the no-bare-print lint tier."""
+
+from __future__ import annotations
+
+import io
+import json
+import pathlib
+import sys
+import tokenize
+
+import numpy as np
+import pytest
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "sparksched_tpu"
+
+
+def _tiny_cfg(tmp_path, **trainer_overrides):
+    cfg = {
+        "trainer": {
+            "trainer_cls": "PPO",
+            "num_iterations": 1,
+            "num_sequences": 1,
+            "num_rollouts": 2,
+            "seed": 0,
+            "use_tensorboard": False,
+            "num_epochs": 1,
+            "num_batches": 2,
+            "beta_discount": 5.0e-3,
+            "opt_kwargs": {"lr": 3.0e-4},
+            "max_grad_norm": 0.5,
+            "rollout_steps": 30,
+            "artifacts_dir": str(tmp_path),
+            "checkpointing_freq": 10**9,
+        },
+        "agent": {
+            "agent_cls": "DecimaScheduler",
+            "embed_dim": 8,
+            "gnn_mlp_kwargs": {
+                "hid_dims": [16, 8],
+                "act_cls": "LeakyReLU",
+                "act_kwargs": {"negative_slope": 0.2},
+            },
+            "policy_mlp_kwargs": {"hid_dims": [16, 16],
+                                  "act_cls": "Tanh"},
+        },
+        "env": {
+            "num_executors": 5,
+            "job_arrival_cap": 3,
+            "moving_delay": 2000.0,
+            "mean_time_limit": 2.0e7,
+            "job_arrival_rate": 4.0e-5,
+            "warmup_delay": 1000.0,
+        },
+        "obs": {"runlog": True, "telemetry": True},
+    }
+    cfg["trainer"].update(trainer_overrides)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# metrics edge case (satellite): all-false mask
+# ---------------------------------------------------------------------------
+
+
+def test_masked_percentiles_all_false_mask():
+    from sparksched_tpu.metrics import PERCENTILE_QS, masked_percentiles
+
+    out = masked_percentiles(
+        np.array([1.0, 2.0, 3.0]), np.zeros(3, dtype=bool)
+    )
+    assert out.shape == (len(PERCENTILE_QS),)
+    np.testing.assert_array_equal(out, np.zeros(len(PERCENTILE_QS)))
+    # batched (pooled) form with an all-false mask too
+    out2 = masked_percentiles(
+        np.zeros((4, 3)), np.zeros((4, 3), dtype=bool)
+    )
+    np.testing.assert_array_equal(out2, np.zeros(len(PERCENTILE_QS)))
+
+
+# ---------------------------------------------------------------------------
+# profiler trace hygiene (satellite): an exception inside a traced block
+# must not leave the process-global tracer running
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_stops_trace_on_exception(tmp_path):
+    import jax
+
+    from sparksched_tpu.trainers.profiler import Profiler
+
+    with pytest.raises(RuntimeError, match="boom"):
+        with Profiler(str(tmp_path / "t1"), quiet=True):
+            raise RuntimeError("boom")
+    # the tracer must be free again: a fresh capture raises
+    # "Only one profile may be run at a time" if __exit__ leaked it
+    jax.profiler.start_trace(str(tmp_path / "t2"))
+    jax.profiler.stop_trace()
+
+
+def test_profiler_sink_receives_span_even_when_quiet():
+    from sparksched_tpu.trainers.profiler import Profiler
+
+    got = []
+    with Profiler(None, "lbl", quiet=True,
+                  sink=lambda n, s: got.append((n, s))):
+        pass
+    assert got and got[0][0] == "lbl" and got[0][1] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# tensorboard import guard (satellite): torch is a heavy optional dep —
+# absence must degrade to the runlog sink, not crash the trainer
+# ---------------------------------------------------------------------------
+
+
+def test_tensorboard_fallback_without_torch(tmp_path, monkeypatch,
+                                            capsys):
+    from sparksched_tpu.trainers import make_trainer
+
+    # simulate an environment without torch: a None sys.modules entry
+    # makes `from torch.utils.tensorboard import ...` raise ImportError
+    for mod in ("torch", "torch.utils", "torch.utils.tensorboard"):
+        monkeypatch.setitem(sys.modules, mod, None)
+    cfg = _tiny_cfg(tmp_path, use_tensorboard=True)
+    t = make_trainer(cfg)
+    t._setup(fresh=True)
+    assert t._tb is None, "fallback must disable the TB mirror"
+    assert "runlog" in capsys.readouterr().out
+    # the default sink is live: stats still land in the runlog
+    t._write_stats(0, {"x": 1.0})
+    t._runlog.close()
+    recs = [json.loads(ln) for ln in open(t._runlog.path)]
+    assert any(r["ev"] == "scalars" and r["x"] == 1.0 for r in recs)
+    t._runlog = None
+
+
+# ---------------------------------------------------------------------------
+# runlog: JIT recompile hooks
+# ---------------------------------------------------------------------------
+
+
+def test_runlog_records_jit_compiles(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.obs import RunLog
+    from sparksched_tpu.obs import runlog as runlog_mod
+
+    monkeypatch.setattr(runlog_mod, "JIT_MIN_SECS", 0.0)
+    rl = RunLog(str(tmp_path / "r.jsonl"))
+    rl.install_jit_hooks()
+
+    @jax.jit
+    def f(x):
+        return (x * 2.0 + 1.0).sum()
+
+    # an off-pattern shape forces a fresh compile
+    jax.block_until_ready(f(jnp.ones((37, 53))))
+    rl.close()
+    recs = [json.loads(ln) for ln in open(rl.path)]
+    compiles = [r for r in recs if r["ev"] == "jit_compile"]
+    assert compiles, "no jit_compile events recorded"
+    assert all("event" in r and "secs" in r for r in compiles)
+    details = [r for r in recs if r["ev"] == "jit_compile_detail"]
+    assert any("f" in r["msg"] for r in details), (
+        "the compile detail records must name the compiled function"
+    )
+
+
+def test_runlog_span_and_json_safety(tmp_path):
+    from sparksched_tpu.obs import RunLog
+
+    rl = RunLog(str(tmp_path / "s.jsonl"))
+    with rl.span("phase", iteration=np.int64(3)):
+        pass
+    with pytest.raises(ValueError):
+        with rl.span("failing"):
+            raise ValueError("x")
+    rl.telemetry({"decisions": np.int32(7)}, iteration=0)
+    rl.close()
+    recs = [json.loads(ln) for ln in open(rl.path)]
+    spans = [r for r in recs if r["ev"] == "span"]
+    assert spans[0]["name"] == "phase" and spans[0]["iteration"] == 3
+    assert spans[1]["error"] == "ValueError"
+    tel = [r for r in recs if r["ev"] == "telemetry"][0]
+    assert tel["summary"]["decisions"] == 7
+    assert recs[-1]["ev"] == "run_end"
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (satellite): one tiny training iteration with obs: enabled
+# produces a valid-JSONL runlog with the expected span/counter keys
+# ---------------------------------------------------------------------------
+
+
+def test_training_iteration_writes_runlog(tmp_path):
+    from sparksched_tpu.trainers import make_trainer
+
+    cfg = _tiny_cfg(tmp_path)
+    t = make_trainer(cfg)
+    t.train()
+    runlogs = list((tmp_path / "runlog").glob("*.jsonl"))
+    assert len(runlogs) == 1
+    recs = []
+    for ln in open(runlogs[0]):
+        recs.append(json.loads(ln))  # every line must parse
+    kinds = {r["ev"] for r in recs}
+    assert {"run_start", "span", "scalars", "telemetry",
+            "run_end"} <= kinds
+    spans = {r["name"] for r in recs if r["ev"] == "span"}
+    assert any("collect" in s for s in spans)
+    assert any("update" in s for s in spans)
+    tel = [r for r in recs if r["ev"] == "telemetry"][-1]["summary"]
+    for key in ("decisions", "composition", "straggler_ratio",
+                "events_by_kind", "micro_per_decision"):
+        assert key in tel, f"telemetry summary missing {key}"
+    assert tel["decisions"] > 0
+    sc = [r for r in recs if r["ev"] == "scalars"][-1]
+    for key in ("collect_seconds", "update_seconds",
+                "straggler_ratio", "avg_num_jobs"):
+        assert key in sc, f"scalars record missing {key}"
+
+
+# ---------------------------------------------------------------------------
+# lint tier (satellite): no bare print( in sparksched_tpu/ outside
+# renderer.py — host-loop output goes through obs.runlog (emit / the
+# JSONL sink) so it stays machine-readable and console-consistent
+# ---------------------------------------------------------------------------
+
+
+def test_no_bare_print_calls_outside_renderer():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name == "renderer.py":
+            continue
+        src = path.read_text()
+        toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+        for i, tok in enumerate(toks):
+            if tok.type != tokenize.NAME or tok.string != "print":
+                continue
+            # a call: next significant token is "("
+            nxt = next(
+                (t for t in toks[i + 1:]
+                 if t.type not in (tokenize.NL, tokenize.NEWLINE,
+                                   tokenize.COMMENT)),
+                None,
+            )
+            if nxt is None or nxt.string != "(":
+                continue
+            # not a method/attribute (e.g. file.print) — check prev
+            prev = next(
+                (t for t in reversed(toks[:i])
+                 if t.type not in (tokenize.NL, tokenize.NEWLINE,
+                                   tokenize.COMMENT, tokenize.INDENT,
+                                   tokenize.DEDENT)),
+                None,
+            )
+            if prev is not None and prev.string in (".", "def"):
+                continue
+            offenders.append(
+                f"{path.relative_to(PKG)}:{tok.start[0]}"
+            )
+    assert not offenders, (
+        "bare print( calls in sparksched_tpu/ (use obs.runlog.emit or "
+        f"the JSONL runlog instead): {offenders}"
+    )

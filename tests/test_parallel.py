@@ -99,7 +99,7 @@ def test_update_jaxpr_contains_cross_device_collectives():
     mesh = make_mesh(n_dev)
     trainer = _make_trainer(num_rollouts=n_dev, mesh=mesh)
     state = trainer.init_state()
-    ro, _ = trainer._collect_jit(
+    ro, _, _ = trainer._collect_jit(
         state.params, state.iteration, state.rng, None
     )
     ro = shard_lanes(ro, mesh)
@@ -123,7 +123,7 @@ def test_mesh_and_single_device_updates_agree():
         trainer = _make_trainer(num_rollouts=n_dev, mesh=m)
         state = trainer.init_state()
         init[name] = jax.device_get(state.params)
-        ro, _ = trainer._collect_jit(
+        ro, _, _ = trainer._collect_jit(
             state.params, state.iteration, state.rng, None
         )
         if m is not None:
@@ -177,7 +177,7 @@ def test_host_device_mesh_shards_and_matches_single_device():
 
     trainer = _make_trainer(num_rollouts=8, mesh=mesh)
     state = trainer.init_state()
-    ro, _ = trainer._collect_jit(
+    ro, _, _ = trainer._collect_jit(
         state.params, state.iteration, state.rng, None
     )
     ro = shard_lanes(ro, mesh)
@@ -189,7 +189,7 @@ def test_host_device_mesh_shards_and_matches_single_device():
 
     single = _make_trainer(num_rollouts=8, mesh=None)
     sstate = single.init_state()
-    sro, _ = single._collect_jit(
+    sro, _, _ = single._collect_jit(
         sstate.params, sstate.iteration, sstate.rng, None
     )
     sstate, _ = single._update_jit(sstate, sro)
