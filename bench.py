@@ -59,8 +59,13 @@ NUM_ENVS = int(os.environ.get("BENCH_NUM_ENVS", 1024))
 # the tunneled v5e faults on >=1024-lane vmaps of the full step (kernel
 # fault at exactly the 8x128 tile boundary); process lanes in sub-batches
 # of 512 via lax.map inside one jit — same program, bounded vector width.
-# Overridable via env vars for on-chip tuning without edits.
-SUB_BATCH = min(int(os.environ.get("BENCH_SUB_BATCH", 512)), NUM_ENVS)
+# Overridable via env vars for on-chip tuning without edits. When the
+# env var is UNSET and an accelerator answers, main() retries the
+# single-pass 1024-lane sub-batch first (PERF.md "known headroom": the
+# fault may have been specific to since-replaced ops), falls back to
+# this default on any failure, and records which was used in the row.
+_SB_ENV = os.environ.get("BENCH_SUB_BATCH")
+SUB_BATCH = min(int(_SB_ENV) if _SB_ENV is not None else 512, NUM_ENVS)
 # the tunnel also kills device programs that run for tens of seconds, so
 # keep each timed program short and accumulate across calls
 BURST = int(os.environ.get("BENCH_BURST", 1))  # event sub-steps per group
@@ -121,13 +126,21 @@ def _metric_suffix() -> str:
     return "_cpu" if jax.default_backend() == "cpu" else ""
 
 
-@partial(jax.jit, static_argnums=(0, 4, 5, 6))
+@partial(
+    jax.jit, static_argnums=(0, 4, 5, 6), static_argnames=("sub_batch",)
+)
 def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events,
-                fulfill_bulk, bulk_cycles=1, telem=None):
+                fulfill_bulk, bulk_cycles=1, telem=None, *,
+                sub_batch=None):
     """MICRO_CHUNK flat micro-steps per lane; returns updated loop
     states, the per-lane telemetry (or None), and the total decision
-    count across the batch."""
+    count across the batch. `sub_batch` overrides the module-level
+    SUB_BATCH (it must be an explicit static arg: the 1024-lane retry
+    re-invokes with a different width, and a global read inside the
+    traced body would silently reuse the first trace)."""
     track = telem is not None
+    if sub_batch is None:
+        sub_batch = SUB_BATCH
 
     def pol(rng, obs):
         si, ne = round_robin_policy(obs, params.num_executors, True)
@@ -144,7 +157,7 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events,
         )
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
-    sub = min(SUB_BATCH, b)
+    sub = min(sub_batch, b)
     tree = (loop_states, rngs, telem) if track else (loop_states, rngs)
     group = jax.tree_util.tree_map(
         lambda a: a.reshape(b // sub, sub, *a.shape[1:]), tree
@@ -208,6 +221,47 @@ def main() -> None:
     states = jax.vmap(lambda k: core.reset(params, bank, k))(reset_keys)
     loop_states = jax.vmap(init_loop_state)(states)
 
+    # --- sub-batch resolution (round-8 headroom retry) -----------------
+    # With BENCH_SUB_BATCH unset and an accelerator answering, try the
+    # single-pass 1024-lane sub-batch first: the >=1024-lane kernel
+    # fault (PERF.md round-1) may have been specific to since-replaced
+    # ops, and success halves the lax.map trip count. ANY failure keeps
+    # the 512 default; the emitted row records which was used
+    # (config.sub_batch) and the retry outcome. CPU never probes — the
+    # fault being retried is accelerator-specific and the fallback's
+    # <=256 clamp is cache-friendliness, not fault avoidance.
+    global SUB_BATCH
+    sub_batch_retry = None
+    if (
+        _SB_ENV is None
+        and not CPU_FALLBACK
+        and jax.default_backend() != "cpu"
+        and NUM_ENVS >= 1024
+        and NUM_ENVS % 1024 == 0
+    ):
+        try:
+            _, _, n = bench_chunk(
+                params, bank, loop_states,
+                jax.random.split(jax.random.PRNGKey(50), NUM_ENVS),
+                8, True, 1, None, sub_batch=1024,
+            )
+            jax.block_until_ready(n)
+        except Exception as err:
+            sub_batch_retry = f"failed: {type(err).__name__}"
+            print(
+                f"# bench: sub-batch 1024 retry failed "
+                f"({type(err).__name__}: {str(err)[:200]}); keeping "
+                f"{SUB_BATCH}",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            sub_batch_retry = "ok"
+            SUB_BATCH = 1024
+            print(
+                "# bench: sub-batch 1024 retry succeeded; using 1024",
+                file=sys.stderr, flush=True,
+            )
+
     # warmup/compile (also warms every calibration candidate). A
     # candidate that fails to compile or run on this backend (e.g. an
     # HBM-exceeding allocation — the tiled-layout cost of a program
@@ -241,26 +295,51 @@ def main() -> None:
             cands += [(0, fb, bc)]
         cands = list(dict.fromkeys(cands))
     telem = telemetry_zeros_like((NUM_ENVS,)) if TELEMETRY else None
-    keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
-    ok_cands = []
-    for i, (be, fb, bc) in enumerate(cands):
-        try:
-            ls_try, tm_try, n = bench_chunk(
-                params, bank, loop_states, keys, be, fb, bc, telem
-            )
-            jax.block_until_ready(n)
-        except Exception as err:
-            print(
-                f"# bench: candidate bulk_events={be} "
-                f"fulfill_bulk={fb} bulk_cycles={bc} skipped "
-                f"({type(err).__name__}: {str(err)[:200]})",
-                file=sys.stderr, flush=True,
-            )
-        else:
-            loop_states = ls_try
-            telem = tm_try
-            ok_cands.append((be, fb, bc))
-        keys = jax.random.split(jax.random.PRNGKey(90 + i), NUM_ENVS)
+
+    def warm_candidates(cands, loop_states, telem):
+        keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
+        ok = []
+        for i, (be, fb, bc) in enumerate(cands):
+            try:
+                ls_try, tm_try, n = bench_chunk(
+                    params, bank, loop_states, keys, be, fb, bc, telem,
+                    sub_batch=SUB_BATCH,
+                )
+                jax.block_until_ready(n)
+            except Exception as err:
+                print(
+                    f"# bench: candidate bulk_events={be} "
+                    f"fulfill_bulk={fb} bulk_cycles={bc} skipped at "
+                    f"sub-batch {SUB_BATCH} "
+                    f"({type(err).__name__}: {str(err)[:200]})",
+                    file=sys.stderr, flush=True,
+                )
+            else:
+                loop_states = ls_try
+                telem = tm_try
+                ok.append((be, fb, bc))
+            keys = jax.random.split(jax.random.PRNGKey(90 + i), NUM_ENVS)
+        return ok, loop_states, telem
+
+    ok_cands, loop_states, telem = warm_candidates(
+        cands, loop_states, telem
+    )
+    if len(ok_cands) < len(cands) and sub_batch_retry == "ok":
+        # the 1024 promotion must not NARROW the calibration set: the
+        # fault being retried is program-dependent, so a candidate that
+        # faults only at the wider width deserves its 512-wide run —
+        # demote and re-warm everything at the safe width instead of
+        # silently calibrating over fewer engine configs
+        SUB_BATCH = 512
+        sub_batch_retry = "demoted: candidate failed at 1024"
+        print(
+            "# bench: demoting sub-batch to 512 (a calibration "
+            "candidate failed at 1024); re-warming all candidates",
+            file=sys.stderr, flush=True,
+        )
+        ok_cands, loop_states, telem = warm_candidates(
+            cands, loop_states, telem
+        )
     if not ok_cands:
         raise RuntimeError("bench: every engine configuration failed")
     cands = ok_cands
@@ -277,7 +356,8 @@ def main() -> None:
             kk = jax.random.split(jax.random.PRNGKey(70 + i), NUM_ENVS)
             tc = time.perf_counter()
             loop_states, telem, n = bench_chunk(
-                params, bank, loop_states, kk, be, fb, bc, telem
+                params, bank, loop_states, kk, be, fb, bc, telem,
+                sub_batch=SUB_BATCH,
             )
             d1 = int(jax.block_until_ready(n))
             rates[(be, fb, bc)] = (d1 - d0) / (time.perf_counter() - tc)
@@ -305,7 +385,7 @@ def main() -> None:
         keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
         loop_states, telem, n = bench_chunk(
             params, bank, loop_states, keys, bulk_events, fulfill_bulk,
-            bulk_cycles, telem,
+            bulk_cycles, telem, sub_batch=SUB_BATCH,
         )
         loop_states = reset_done_lanes(
             params, bank, loop_states,
@@ -331,6 +411,9 @@ def main() -> None:
         "config": {
             "num_envs": NUM_ENVS,
             "sub_batch": SUB_BATCH,
+            # None: pinned by env var / CPU / lane count not applicable;
+            # "ok"/"failed: ...": the 1024-lane single-pass retry outcome
+            "sub_batch_retry_1024": sub_batch_retry,
             "burst": BURST,
             "bulk_events": int(bulk_events),
             "fulfill_bulk": bool(fulfill_bulk),

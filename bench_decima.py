@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -35,6 +36,7 @@ from sparksched_tpu.schedulers import DecimaScheduler
 from sparksched_tpu.trainers.ppo import PPO
 from sparksched_tpu.trainers.rollout import (
     collect_flat_sync,
+    collect_flat_sync_batch,
     collect_sync,
     flat_micro_group_budget,
 )
@@ -63,6 +65,15 @@ def _flat_knobs() -> dict:
     }
 
 
+def _job_cap_candidates() -> list[int]:
+    """Compaction-bucket K candidates for the decima_fastpath rows
+    (round-8 tentpole): calibrated like bench.py's engine knobs, pinned
+    by setting a single value. Every emitted row records the candidate
+    list and the bucket it ran with (0 = compaction off)."""
+    raw = os.environ.get("BENCH_DECIMA_JOB_CAP", "8,16,32")
+    return [int(x) for x in raw.split(",") if x.strip()]
+
+
 def bench_inference(
     num_envs: int = 64, steps: int = 512,
     compute_dtype: str | None = None, engine: str = "core",
@@ -70,7 +81,10 @@ def bench_inference(
     """Rollout-collection throughput (valid decision steps/s). `engine`
     selects the collector: "core" = per-decision `collect_sync` scan,
     "flat" = `collect_flat_sync` over the flat micro-step engine (the
-    decima_flat row; knobs from `_flat_knobs`)."""
+    decima_flat row; knobs from `_flat_knobs`), "fastpath" = the round-8
+    single-eval batch collector (`collect_flat_sync_batch`: one batched
+    GNN evaluation per decision row + active-job compaction, bucket K
+    calibrated over `BENCH_DECIMA_JOB_CAP` candidates)."""
     params = EnvParams(
         num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
         moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
@@ -96,13 +110,33 @@ def bench_inference(
     pol = sched.flat_policy()
     knobs = _flat_knobs()
     micro_per_dec = float(os.environ.get("DEC_BENCH_FLAT_MICRO", 4.0))
+    job_bucket = 0
+    job_caps = _job_cap_candidates()
 
     telem = telemetry_zeros_like((num_envs,)) if TELEMETRY else None
     # one vmapped call covers telemetry on AND off: vmap treats a None
     # argument as an empty pytree, and the collector's return shape
     # switches on the Python-level None check at trace time (the same
     # pattern as trainer._collect)
-    if engine == "flat":
+    if engine == "fastpath":
+        def make_run(k):
+            # the bucket is read at trace time; a fresh batch-policy
+            # closure per candidate forces its own compile
+            sched.job_bucket = int(k)
+            bpol = sched.flat_batch_policy()
+
+            @jax.jit
+            def run(states, key, tm):
+                out = collect_flat_sync_batch(
+                    params, bank, bpol, key, steps, states, tm,
+                    fulfill_bulk=knobs["fulfill_bulk"],
+                    bulk_events=knobs["bulk_events"],
+                    bulk_cycles=knobs["bulk_cycles"],
+                )
+                return out if tm is not None else (out, None)
+
+            return run
+    elif engine == "flat":
         micro_groups = flat_micro_group_budget(
             steps, micro_per_dec, knobs["event_burst"]
         )
@@ -128,33 +162,68 @@ def bench_inference(
 
     keys = jax.random.split(jax.random.PRNGKey(0), num_envs)
     states = jax.vmap(lambda k: core.reset(params, bank, k))(keys)
-    ro, telem = run(
-        states, jax.random.split(jax.random.PRNGKey(1), num_envs), telem
-    )
-    jax.block_until_ready(ro.reward)  # compile + warm
+
+    def rngs_for(seed):
+        if engine == "fastpath":
+            return jax.random.PRNGKey(seed)  # batch collector: one key
+        return jax.random.split(jax.random.PRNGKey(seed), num_envs)
+
+    if engine == "fastpath":
+        # calibrate the compaction bucket K over the candidate list
+        # (bench.py's self-calibration pattern: warm each candidate,
+        # time one chunk, keep the winner for the timed run)
+        rates = {}
+        runs = {}
+        for k in job_caps:
+            runs[k] = make_run(k)
+            ro, telem = runs[k](states, rngs_for(1), telem)
+            jax.block_until_ready(ro.reward)  # compile + warm
+            tc = time.perf_counter()
+            ro, telem = runs[k](states, rngs_for(40 + k), telem)
+            n = int(jax.block_until_ready(ro.valid).sum())
+            rates[k] = n / (time.perf_counter() - tc)
+            if len(job_caps) > 1:
+                print(
+                    f"# bench_decima: fastpath K={k}: "
+                    f"{rates[k]:.1f} steps/s",
+                    file=sys.stderr, flush=True,
+                )
+        job_bucket = max(rates, key=rates.get)
+        run = runs[job_bucket]
+    else:
+        ro, telem = run(states, rngs_for(1), telem)
+        jax.block_until_ready(ro.reward)  # compile + warm
     telem_snap = jax.device_get(telem) if TELEMETRY else None
 
     t0 = time.perf_counter()
     n_timed = 2
     total = 0
     for i in range(n_timed):
-        ro, telem = run(
-            states,
-            jax.random.split(jax.random.PRNGKey(2 + i), num_envs),
-            telem,
-        )
+        ro, telem = run(states, rngs_for(2 + i), telem)
         total += int(jax.block_until_ready(ro.valid).sum())
     dt = time.perf_counter() - t0
     value = total / dt
     tag = f"_{compute_dtype}" if compute_dtype else ""
-    eng_tag = "_flat" if engine == "flat" else ""
+    eng_tag = {"flat": "_flat", "fastpath": "_fastpath"}.get(engine, "")
     cfg = {
         "num_envs": num_envs,
         "engine": engine,
+        # the compaction bucket this row ran with (0 = off) and the
+        # calibration surface it was chosen from — part of EVERY row so
+        # numbers are only compared at equal config
+        "job_bucket": int(job_bucket),
+        "job_cap_candidates": job_caps,
         "prng_impl": str(jax.config.jax_default_prng_impl),
         "backend": jax.default_backend(),
         "telemetry": TELEMETRY,
     }
+    if engine == "fastpath":
+        cfg |= {
+            "single_eval": True,
+            "fulfill_bulk": knobs["fulfill_bulk"],
+            "bulk_events": knobs["bulk_events"],
+            "bulk_cycles": knobs["bulk_cycles"],
+        }
     if engine == "flat":
         cfg |= {"micro_per_decision": micro_per_dec} | knobs
     row = {
@@ -274,6 +343,8 @@ def bench_ppo(
             "num_envs": num_envs,
             "rollout_steps": rollout_steps,
             "engine": engine,
+            "job_bucket": int(cfg_agent.get("job_bucket", 0)),
+            "single_eval": bool(trainer.flat_single_eval),
             "prng_impl": str(jax.config.jax_default_prng_impl),
             "backend": jax.default_backend(),
             "telemetry": TELEMETRY,
@@ -312,6 +383,13 @@ if __name__ == "__main__":
     bench_inference(
         num_envs=infer_envs, steps=infer_steps, compute_dtype="bfloat16",
         engine="flat",
+    )
+    bench_inference(
+        num_envs=infer_envs, steps=infer_steps, engine="fastpath"
+    )
+    bench_inference(
+        num_envs=infer_envs, steps=infer_steps, compute_dtype="bfloat16",
+        engine="fastpath",
     )
     bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps)
     bench_ppo(

@@ -161,6 +161,11 @@ def stage_bench_decima_flat():
         ("infer flat bf16",
          lambda: bench_decima.bench_inference(
              compute_dtype="bfloat16", engine="flat")),
+        ("infer fastpath f32",
+         lambda: bench_decima.bench_inference(engine="fastpath")),
+        ("infer fastpath bf16",
+         lambda: bench_decima.bench_inference(
+             compute_dtype="bfloat16", engine="fastpath")),
         ("ppo flat", lambda: bench_decima.bench_ppo(engine="flat")),
     ))
 

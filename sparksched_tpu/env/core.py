@@ -39,6 +39,8 @@ from .state import (
     INF,
     EnvState,
     empty_state,
+    topo_levels,  # shared levels reduction (re-exported; observe/tests
+    # and the golden property all use the single state.py copy)
 )
 
 _i32 = jnp.int32
@@ -785,29 +787,30 @@ def _fulfill_from_source(
 # --------------------------------------------------------------------------
 
 
-def topo_levels(active: jnp.ndarray, adj_act: jnp.ndarray) -> jnp.ndarray:
-    """i32[J,S] topological generation of each active node in the masked
-    subgraph; padding = S. Matches nx.topological_generations on the
-    observed dag batch (reference decima/utils.py:238-267)."""
-    s_cap = active.shape[1]
+def _job_topo_levels(active_s: jnp.ndarray, adj_s: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """i32[S] topological generation of one job's active nodes in the
+    masked [S,S] subgraph; padding = S. Single-job form of `topo_levels`,
+    used by the incremental `state.node_level` maintenance — an S-bounded
+    pass over one job instead of the [J,S,S] all-jobs reduction."""
+    s_cap = active_s.shape[0]
 
     def body(_, lvl):
-        cand = jnp.where(adj_act, lvl[:, :, None] + 1, 0).max(axis=1)
+        cand = jnp.where(adj_s, lvl[:, None] + 1, 0).max(axis=0)
         return jnp.maximum(lvl, cand)
 
-    lvl = lax.fori_loop(
-        0, s_cap, body, jnp.zeros(active.shape, _i32)
-    )
-    return jnp.where(active, lvl, s_cap)
+    lvl = lax.fori_loop(0, s_cap, body, jnp.zeros(active_s.shape, _i32))
+    return jnp.where(active_s, lvl, s_cap)
 
 
 def compute_node_levels(params: EnvParams, state: EnvState) -> jnp.ndarray:
     """Active-subgraph topological generations (completed stages and
     inactive jobs excluded — the same node set as the observation's
     `node_mask`, so an Observation rebuilt from a stored rollout step is
-    bit-identical to the live one). Computed once per observation rather
-    than incrementally per event: a 20-deep dependent-op chain inside the
-    event while-loop was pure latency on TPU."""
+    bit-identical to the live one). Since round 8 this full [J,S,S]
+    recomputation is the GOLDEN reference only: `observe` reads the
+    state-maintained `node_level` cache, updated per stage completion
+    (`_handle_task_finished`) with a single-job `_job_topo_levels` pass."""
     active = (
         state.job_active[:, None]
         & state.stage_exists
@@ -884,6 +887,19 @@ def _handle_task_finished(state: EnvState, e: jnp.ndarray):
             incomplete_parent_count=st.incomplete_parent_count
             - (stage_done & oj[:, None] & st.adj[j, s][None, :]).astype(
                 _i32
+            )
+        )
+        # maintain the node-level cache: the completed stage leaves job
+        # j's active subgraph, so recompute THAT job's row only (stage
+        # completion is the sole mutation point — the bulk passes only
+        # launch tasks and can never complete a stage)
+        act_row = st.stage_exists[j] & ~st.stage_completed[j]
+        adj_row = st.adj[j] & act_row[:, None] & act_row[None, :]
+        lvl_row = _job_topo_levels(act_row, adj_row)
+        st = st.replace(
+            node_level=jnp.where(
+                stage_done & oj[:, None], lvl_row[None, :],
+                st.node_level,
             )
         )
         new_frontier = st.frontier[j] & ~frontier_before
@@ -1658,6 +1674,7 @@ def reset_from_sequence(
         stage_sat=sat0,
         unsat_parent_count=unsat0,
         incomplete_parent_count=ipc0,
+        node_level=topo_levels(exists, adj),
         time_limit=time_limit,
         seq_counter=num_jobs,
         job_template=templates,

@@ -212,6 +212,11 @@ def _bulk_cycle_chain(
     return env, nb, nb_rel, nb_rdy
 
 
+def _lane_done(env: EnvState) -> jnp.ndarray:
+    """Episode over: all jobs complete or the time limit was crossed."""
+    return env.all_jobs_complete | (env.wall_time >= env.time_limit)
+
+
 def _fused_pop_gate(env: EnvState, nb: jnp.ndarray) -> jnp.ndarray:
     """May this micro-step still pop the run-cutting event after its
     bulk passes consumed `nb` events? Always when nothing was bulked
@@ -233,6 +238,138 @@ def _clear_round(st: EnvState) -> EnvState:
         round_ready=jnp.bool_(False),
         schedulable=jnp.zeros_like(st.schedulable),
     )
+
+
+def _apply_decision(
+    params: EnvParams, ls: LoopState, stage_idx: jnp.ndarray,
+    num_exec: jnp.ndarray, fulfill_bulk: bool,
+) -> LoopState:
+    """core.step's front half for ONE precomputed policy decision on
+    `ls.env`: commit (or round finish), fulfillment-phase setup, mode
+    bookkeeping. Shared by `micro_step`'s DECIDE branch and the
+    single-eval `decide_micro_step` so the two can never drift. The
+    caller runs the shared `_finish_micro_step` tail."""
+    st = ls.env
+    n = st.exec_job.shape[0]
+    s_cap = params.max_stages
+    j, s = stage_idx // s_cap, stage_idx % s_cap
+    valid = (
+        (stage_idx >= 0)
+        & (stage_idx < params.num_nodes)
+        & st.schedulable[j, s]
+    )
+
+    def do_commit(stt: EnvState) -> EnvState:
+        committable = stt.num_committable()
+        nn = jnp.clip(num_exec, 1, committable)
+        nn = jnp.minimum(nn, stt.exec_demand[j, s])
+        stt = _add_commitment(stt, nn, j, s)
+        j_cap, s_cap2 = stt.stage_selected.shape
+        sel = _onehot2(j_cap, s_cap2, j, s)
+        stt = stt.replace(stage_selected=stt.stage_selected | sel)
+        return stt.replace(
+            schedulable=find_schedulable(
+                params, stt, stt.source_job_id()
+            )
+        )
+
+    st = lax.cond(valid, do_commit, _commit_remaining, st)
+    round_continues = (
+        (st.num_committable() > 0) & st.schedulable.any()
+    )
+
+    def finish(st: EnvState):
+        st = _commit_remaining(st)
+        idle = st.source_pool_mask() & ~st.exec_executing
+        num_idle = idle.sum().astype(_i32)
+        exec_order = _rank_order(
+            jnp.where(idle, jnp.arange(n), BIG_SEQ)
+        )
+        match = (
+            st.cm_valid
+            & (st.cm_src_job == st.source_job)
+            & (st.cm_src_stage == st.source_stage)
+        )
+        slot_order = _rank_order(
+            jnp.where(match, st.cm_seq, BIG_SEQ)
+        )
+        if fulfill_bulk:
+            # the bulk pass samples durations, and bank accesses
+            # must stay OUT of lane-dependent branches: batching a
+            # cond instantiates branch constants as broadcast
+            # outputs, materializing a per-lane copy of the bank's
+            # [T,S,3,L,K] duration table (a 19 GB HBM allocation at
+            # 512 lanes on the v5e). The pass runs unconditionally
+            # in the shared tail (_finish_micro_step), gated by
+            # mode — exactly like the relaunch cascade above the
+            # switch — along with the complete/clear/mode step.
+            return st, _i32(M_FULFILL), num_idle, exec_order, \
+                slot_order, _i32(0)
+        k0 = _i32(0)
+        # phase already complete (empty): clear and go straight to
+        # events — matching core.step, which clears only after
+        # _fulfill_from_source returns (no leftover backup search
+        # remains to observe stage_selected)
+        complete = k0 >= num_idle
+        st = lax.cond(complete, _clear_round, lambda x: x, st)
+        mode = jnp.where(complete, M_EVENT, M_FULFILL)
+        return st, mode.astype(_i32), num_idle, exec_order, \
+            slot_order, k0
+
+    def stay(st: EnvState):
+        return (
+            st, _i32(M_DECIDE), _i32(0), ls.exec_order,
+            ls.slot_order, _i32(0),
+        )
+
+    st, mode, num_idle, eo, so, k0 = lax.cond(
+        round_continues, stay, finish, st
+    )
+    return ls.replace(
+        env=st,
+        mode=mode,
+        fulfill_k=k0,
+        num_idle=num_idle,
+        exec_order=eo,
+        slot_order=so,
+        decisions=ls.decisions + 1,
+    )
+
+
+def _fulfill_branch(ls: LoopState):
+    """One commitment fulfillment (core._fulfill_from_source body, one k
+    per micro-step). Returns (ls, rk, rj, rs, e, quirk, popped, kind) —
+    the shared-tail argument tuple."""
+    st = ls.env
+    k = ls.fulfill_k
+    e = ls.exec_order[k]
+    quirk = st.source_job_id()
+
+    def do(st: EnvState):
+        return _fulfill_commitment_phase_a(st, e, ls.slot_order[k])
+
+    def skip(st: EnvState):
+        return st, _i32(RQ_NONE), _i32(-1), _i32(-1)
+
+    st, rk, rj, rs = lax.cond(k < ls.num_idle, do, skip, st)
+    last = k + 1 >= ls.num_idle
+    # round clearing is deferred to the shared tail (after this
+    # fulfillment's resolve/apply), matching core.step which clears
+    # only after _fulfill_from_source returns — the final executor's
+    # backup-stage search must still see stage_selected
+    mode = jnp.where(last, M_EVENT, M_FULFILL).astype(_i32)
+    return ls.replace(env=st, mode=mode, fulfill_k=k + 1), rk, rj, rs, \
+        e, quirk, jnp.bool_(False), _i32(0)
+
+
+def _event_branch(params: EnvParams, ls: LoopState, nb: jnp.ndarray):
+    """One event pop + handling (core._resume_simulation body) with the
+    fused-pop gate over the `nb` events the bulk passes just consumed.
+    Returns the shared-tail argument tuple."""
+    st, rk, rj, rs, arg, quirk, popped, kind = _pop_event(
+        params, ls.env, _fused_pop_gate(ls.env, nb)
+    )
+    return ls.replace(env=st), rk, rj, rs, arg, quirk, popped, kind
 
 
 def micro_step(
@@ -309,7 +446,6 @@ def micro_step(
         nb = _i32(0)
         nb_rel = nb_rdy = nb
     st = ls.env
-    n = st.exec_job.shape[0]
     s_cap = params.max_stages
 
     if record:
@@ -322,131 +458,29 @@ def micro_step(
             r_aux, r_stage, r_nexec, s_cap
         )
 
-    # ---- DECIDE: one commitment from the policy (core.step's front half)
+    # ---- DECIDE: one commitment from the policy (core.step's front
+    # half; the commit/round logic lives in the shared `_apply_decision`)
     def decide(ls: LoopState):
         if record:
-            obs, stage_idx, num_exec = r_obs, r_stage, r_nexec
+            stage_idx, num_exec = r_stage, r_nexec
         else:
             obs = observe(params, ls.env, compute_levels)
             stage_idx, num_exec, _ = policy_fn(k_pol, obs)
-        st = ls.env
-        j, s = stage_idx // s_cap, stage_idx % s_cap
-        valid = (
-            (stage_idx >= 0)
-            & (stage_idx < params.num_nodes)
-            & st.schedulable[j, s]
-        )
-
-        def do_commit(stt: EnvState) -> EnvState:
-            committable = stt.num_committable()
-            nn = jnp.clip(num_exec, 1, committable)
-            nn = jnp.minimum(nn, stt.exec_demand[j, s])
-            stt = _add_commitment(stt, nn, j, s)
-            j_cap, s_cap2 = stt.stage_selected.shape
-            sel = _onehot2(j_cap, s_cap2, j, s)
-            stt = stt.replace(stage_selected=stt.stage_selected | sel)
-            return stt.replace(
-                schedulable=find_schedulable(
-                    params, stt, stt.source_job_id()
-                )
-            )
-
-        st = lax.cond(valid, do_commit, _commit_remaining, st)
-        round_continues = (
-            (st.num_committable() > 0) & st.schedulable.any()
-        )
-
-        def finish(st: EnvState):
-            st = _commit_remaining(st)
-            idle = st.source_pool_mask() & ~st.exec_executing
-            num_idle = idle.sum().astype(_i32)
-            exec_order = _rank_order(
-                jnp.where(idle, jnp.arange(n), BIG_SEQ)
-            )
-            match = (
-                st.cm_valid
-                & (st.cm_src_job == st.source_job)
-                & (st.cm_src_stage == st.source_stage)
-            )
-            slot_order = _rank_order(
-                jnp.where(match, st.cm_seq, BIG_SEQ)
-            )
-            if fulfill_bulk:
-                # the bulk pass samples durations, and bank accesses
-                # must stay OUT of lane-dependent branches: batching a
-                # cond instantiates branch constants as broadcast
-                # outputs, materializing a per-lane copy of the bank's
-                # [T,S,3,L,K] duration table (a 19 GB HBM allocation at
-                # 512 lanes on the v5e). The pass runs unconditionally
-                # in the shared tail (_finish_micro_step), gated by
-                # mode — exactly like the relaunch cascade above the
-                # switch — along with the complete/clear/mode step.
-                return st, _i32(M_FULFILL), num_idle, exec_order, \
-                    slot_order, _i32(0)
-            k0 = _i32(0)
-            # phase already complete (empty): clear and go straight to
-            # events — matching core.step, which clears only after
-            # _fulfill_from_source returns (no leftover backup search
-            # remains to observe stage_selected)
-            complete = k0 >= num_idle
-            st = lax.cond(complete, _clear_round, lambda x: x, st)
-            mode = jnp.where(complete, M_EVENT, M_FULFILL)
-            return st, mode.astype(_i32), num_idle, exec_order, \
-                slot_order, k0
-
-        def stay(st: EnvState):
-            return (
-                st, _i32(M_DECIDE), _i32(0), ls.exec_order,
-                ls.slot_order, _i32(0),
-            )
-
-        st, mode, num_idle, eo, so, k0 = lax.cond(
-            round_continues, stay, finish, st
-        )
-        return ls.replace(
-            env=st,
-            mode=mode,
-            fulfill_k=k0,
-            num_idle=num_idle,
-            exec_order=eo,
-            slot_order=so,
-            decisions=ls.decisions + 1,
-        ), _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(0), \
-            st.source_job_id(), jnp.bool_(False), _i32(0)
+        ls2 = _apply_decision(params, ls, stage_idx, num_exec, fulfill_bulk)
+        return ls2, _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(0), \
+            ls2.env.source_job_id(), jnp.bool_(False), _i32(0)
 
     # ---- FULFILL: one commitment fulfillment (core._fulfill_from_source
     # body, one k per micro-step)
     def fulfill(ls: LoopState):
-        st = ls.env
-        k = ls.fulfill_k
-        e = ls.exec_order[k]
-        quirk = st.source_job_id()
-
-        def do(st: EnvState):
-            return _fulfill_commitment_phase_a(st, e, ls.slot_order[k])
-
-        def skip(st: EnvState):
-            return st, _i32(RQ_NONE), _i32(-1), _i32(-1)
-
-        st, rk, rj, rs = lax.cond(k < ls.num_idle, do, skip, st)
-        last = k + 1 >= ls.num_idle
-        # round clearing is deferred to the shared tail (after this
-        # fulfillment's resolve/apply), matching core.step which clears
-        # only after _fulfill_from_source returns — the final executor's
-        # backup-stage search must still see stage_selected
-        mode = jnp.where(last, M_EVENT, M_FULFILL).astype(_i32)
-        return ls.replace(env=st, mode=mode, fulfill_k=k + 1), rk, rj, rs, \
-            e, quirk, jnp.bool_(False), _i32(0)
+        return _fulfill_branch(ls)
 
     # ---- EVENT: one event pop + handling (core._resume_simulation
     # body). Fused pop: even after the bulk passes consumed events, the
     # run-cutting event they stopped at is popped in the same micro-step
     # when the skipped between-event tail is provably a no-op
     def event(ls: LoopState):
-        st, rk, rj, rs, arg, quirk, popped, kind = _pop_event(
-            params, ls.env, _fused_pop_gate(ls.env, nb)
-        )
-        return ls.replace(env=st), rk, rj, rs, arg, quirk, popped, kind
+        return _event_branch(params, ls, nb)
 
     with annotate("env/micro_step"):
         ls2, rk, rj, rs, e, quirk, popped, ev_kind = lax.switch(
@@ -462,10 +496,7 @@ def micro_step(
         out = out[0] if len(out) == 1 else tuple(out)
     # frozen lanes (auto_reset=False, episode already over at entry) must
     # not report a decision — the tail rolls their state/counters back
-    was_done = (
-        ls0.env.all_jobs_complete
-        | (ls0.env.wall_time >= ls0.env.time_limit)
-    )
+    was_done = _lane_done(ls0.env)
     if track:
         live = ~was_done
         pop_live = popped & live
@@ -544,10 +575,7 @@ def _finish_micro_step(
             params, bank, st, ni, ls2.exec_order, ls2.slot_order
         )
         if telem is not None:
-            live = ~(
-                ls.env.all_jobs_complete
-                | (ls.env.wall_time >= ls.env.time_limit)
-            )
+            live = ~_lane_done(ls.env)
             telem = _tm_add(
                 telem, bulk_fulfill_hits=jnp.where(live, k0, 0)
             )
@@ -606,11 +634,8 @@ def _finish_micro_step(
     # episode end: auto-reset (unconditional reset + select keeps the
     # workload bank out of lane-dependent conditionals); with
     # auto_reset=False finished lanes freeze instead (tests, evals)
-    done = st.all_jobs_complete | (st.wall_time >= st.time_limit)
-    was_done = (
-        ls.env.all_jobs_complete
-        | (ls.env.wall_time >= ls.env.time_limit)
-    )
+    done = _lane_done(st)
+    was_done = _lane_done(ls.env)
     if record:
         # reward/dt on the PRE-reset state (the reset select below would
         # lose the episode's final span); frozen lanes report zeros
@@ -715,10 +740,7 @@ def event_micro_step(
     if record:
         out, (rw, dt, rs_) = out
     if track:
-        was_done = (
-            ls0.env.all_jobs_complete
-            | (ls0.env.wall_time >= ls0.env.time_limit)
-        )
+        was_done = _lane_done(ls0.env)
         gate = is_event & ~was_done
         pop_live = popped & gate
         telemetry = _tm_add(
@@ -744,6 +766,210 @@ def event_micro_step(
         )
         return (final, rec_tail, telemetry) if track else (final, rec_tail)
     return (final, telemetry) if track else final
+
+
+def decide_micro_step(
+    params: EnvParams,
+    bank: WorkloadBank,
+    ls: LoopState,
+    stage_idx: jnp.ndarray,
+    num_exec: jnp.ndarray,
+    rng: jax.Array,
+    auto_reset: bool = True,
+    fulfill_bulk: bool = False,
+    reset_fn: Callable | None = None,
+    t_ref: jnp.ndarray | None = None,
+    telemetry=None,
+) -> tuple:
+    """One DECIDE-only micro-step driven by a PRECOMPUTED policy decision:
+    lanes in M_DECIDE mode commit (or round-finish) via the shared
+    `_apply_decision` + `_finish_micro_step` pair; other lanes no-op
+    bit-exactly (their rng/state must not advance). The single-eval flat
+    collectors (`trainers/rollout.py:collect_flat_*_batch`) evaluate the
+    policy ONCE per decision row at batch level and feed the outputs
+    here, so the GNN appears exactly once per recorded decision instead
+    of once per micro-step group. Returns
+    `(ls, (decided, reward, dt, reset)[, telemetry])`; `decided` marks
+    lanes that recorded a decision (live and in DECIDE mode at entry)."""
+    track = telemetry is not None
+    is_dec = ls.mode == M_DECIDE
+    _, k_reset = jax.random.split(rng)
+    # force the tail's mode-keyed logic to the DECIDE shape for every
+    # lane (the event_micro_step pattern): non-decide lanes' branch
+    # results are discarded by the final select below
+    ls0 = ls.replace(mode=_i32(M_DECIDE))
+    ls2 = _apply_decision(params, ls0, stage_idx, num_exec, fulfill_bulk)
+    mode2 = ls2.mode  # pre-tail mode: DECIDE -> non-DECIDE == round done
+    out = _finish_micro_step(
+        params, bank, ls0, ls2, _i32(RQ_NONE), _i32(-1), _i32(-1),
+        _i32(0), ls2.env.source_job_id(), k_reset, auto_reset,
+        fulfill_bulk=fulfill_bulk, record=True, reset_fn=reset_fn,
+        t_ref=t_ref, telem=telemetry,
+    )
+    if track:
+        out_ls, (rw, dt, rs_), telemetry = out
+    else:
+        out_ls, (rw, dt, rs_) = out
+    was_done = _lane_done(ls.env)
+    decided = is_dec & ~was_done
+    if track:
+        telemetry = _tm_add(
+            telemetry,
+            decide_steps=decided,
+            commit_rounds=decided & (mode2 != M_DECIDE),
+        )
+    final = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(is_dec, a, b), out_ls, ls
+    )
+    zero = jnp.float32(0.0)
+    rec = (
+        decided,
+        jnp.where(is_dec, rw, zero),
+        jnp.where(is_dec, dt, zero),
+        is_dec & rs_,
+    )
+    return (final, rec, telemetry) if track else (final, rec)
+
+
+def drain_micro_step(
+    params: EnvParams,
+    bank: WorkloadBank,
+    ls: LoopState,
+    rng: jax.Array,
+    auto_reset: bool = True,
+    event_bulk: bool = True,
+    bulk_events: int = 8,
+    bulk_cycles: int = 1,
+    reset_fn: Callable | None = None,
+    t_ref: jnp.ndarray | None = None,
+    telemetry=None,
+) -> tuple:
+    """One NON-POLICY micro-step: FULFILL and EVENT lanes advance exactly
+    as `micro_step`'s branches (bulk passes + fused pop included); DECIDE
+    lanes no-op bit-exactly. Contains no observe/policy ops at all — the
+    point of the single-eval restructure is that this program, not the
+    policy-bearing one, runs between decisions. Returns
+    `(ls, (reward, dt, reset)[, telemetry])`."""
+    track = telemetry is not None
+    active = ls.mode != M_DECIDE
+    _, k_reset = jax.random.split(rng)
+    ls0 = ls
+    if event_bulk:
+        env_b, nb, nb_rel, nb_rdy = _bulk_cycle_chain(
+            params, bank, ls.env, ls.mode == M_EVENT, bulk_events,
+            bulk_cycles,
+        )
+        ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
+    else:
+        nb = _i32(0)
+        nb_rel = nb_rdy = nb
+
+    def noop(ls: LoopState):
+        return ls, _i32(RQ_NONE), _i32(-1), _i32(-1), _i32(0), \
+            ls.env.source_job_id(), jnp.bool_(False), _i32(0)
+
+    ls2, rk, rj, rs, e, quirk, popped, ev_kind = lax.switch(
+        ls.mode,
+        [noop, _fulfill_branch, lambda l: _event_branch(params, l, nb)],
+        ls,
+    )
+    out = _finish_micro_step(
+        params, bank, ls0, ls2, rk, rj, rs, e, quirk, k_reset,
+        auto_reset, record=True, reset_fn=reset_fn, t_ref=t_ref,
+        telem=telemetry,
+    )
+    if track:
+        out_ls, (rw, dt, rs_), telemetry = out
+    else:
+        out_ls, (rw, dt, rs_) = out
+    was_done = _lane_done(ls0.env)
+    gate = active & ~was_done
+    if track:
+        pop_live = popped & gate
+        telemetry = _tm_add(
+            telemetry,
+            fulfill_steps=(ls0.mode == M_FULFILL) & ~was_done,
+            event_steps=(ls0.mode == M_EVENT) & ~was_done,
+            loop_iters=jnp.where(gate, nb + popped.astype(_i32), 0),
+            bulk_relaunch_events=jnp.where(gate, nb_rel, 0),
+            bulk_ready_events=jnp.where(gate, nb_rdy, 0),
+            ev_job_arrival=pop_live & (ev_kind == EV_JOB_ARRIVAL),
+            ev_task_finished=pop_live & (ev_kind == EV_TASK_FINISHED),
+            ev_exec_ready=pop_live & (ev_kind == EV_EXECUTOR_READY),
+        )
+    final = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(active, a, b), out_ls, ls0
+    )
+    zero = jnp.float32(0.0)
+    rec = (
+        jnp.where(active, rw, zero),
+        jnp.where(active, dt, zero),
+        active & rs_,
+    )
+    return (final, rec, telemetry) if track else (final, rec)
+
+
+def drain_to_decision(
+    params: EnvParams,
+    bank: WorkloadBank,
+    ls: LoopState,
+    rng: jax.Array,
+    auto_reset: bool = True,
+    event_bulk: bool = True,
+    bulk_events: int = 8,
+    bulk_cycles: int = 1,
+    reset_fn: Callable | None = None,
+    t_ref: jnp.ndarray | None = None,
+    telemetry=None,
+) -> tuple:
+    """Drain one lane's non-decision work — FULFILL leftovers and the
+    whole inter-decision event run — until it is ready to DECIDE again
+    (or its episode is over / its event queue is drained), accumulating
+    the span's reward/dt/reset with `t_ref` as the discount reference.
+
+    The batch collectors vmap this; under vmap the while-loop costs the
+    batch-max drain length per decision row — but every iteration is
+    pure env machinery (bulk passes + single pops), so the straggler tax
+    lands on the cheap slice while the GNN, the decision row's measured
+    70-90% share, runs exactly once per decision outside this loop.
+    Returns `(ls, (reward, dt, reset)[, telemetry])`."""
+    track = telemetry is not None
+    zero = jnp.float32(0.0)
+
+    def cond(c):
+        ls = c[0]
+        has, _, _, _ = _next_event(params, ls.env)
+        # a drained queue with the episode still open cannot progress
+        # without a new decision round — hand such a lane back to the
+        # caller instead of spinning forever
+        stuck = (ls.mode == M_EVENT) & ~has & ~ls.env.round_ready
+        return (ls.mode != M_DECIDE) & ~_lane_done(ls.env) & ~stuck
+
+    def body(c):
+        if track:
+            ls, k, rw, dt, rs, tm = c
+        else:
+            (ls, k, rw, dt, rs), tm = c, None
+        k, sub = jax.random.split(k)
+        out = drain_micro_step(
+            params, bank, ls, sub, auto_reset, event_bulk, bulk_events,
+            bulk_cycles, reset_fn, t_ref, telemetry=tm,
+        )
+        if track:
+            ls, (r, d, re), tm = out
+        else:
+            ls, (r, d, re) = out
+        c2 = (ls, k, rw + r, dt + d, rs | re)
+        return c2 + (tm,) if track else c2
+
+    c0 = (ls, rng, zero, zero, jnp.bool_(False))
+    if track:
+        c0 = c0 + (telemetry,)
+    c = lax.while_loop(cond, body, c0)
+    ls, rw, dt, rs = c[0], c[2], c[3], c[4]
+    if track:
+        return ls, (rw, dt, rs), c[5]
+    return ls, (rw, dt, rs)
 
 
 def run_flat(

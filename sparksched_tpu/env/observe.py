@@ -16,7 +16,6 @@ from flax import struct
 
 from ..config import EnvParams
 from .state import EnvState
-from . import core as _core
 
 NUM_NODE_FEATURES = 3  # reference spark_sched_sim.py:25
 
@@ -51,11 +50,14 @@ class Observation(struct.PyTreeNode):
 def observe(
     params: EnvParams, state: EnvState, compute_levels: bool = True
 ) -> Observation:
-    """`compute_levels=False` skips the S-deep topological-generation
-    fori_loop (an [J,S,S] reduction per level — by far the most expensive
-    part of an observation) and fills `node_level` with the padding value.
-    Only the Decima GNN reads `node_level`; heuristic policies must pass
-    False on hot paths."""
+    """`node_level` comes from the state-maintained incremental cache
+    (`state.node_level`, updated per stage completion), masked to the
+    active jobs — a gather+select instead of the S-deep [J,S,S]
+    topological-generation fori_loop that used to be by far the most
+    expensive part of an observation (`core.compute_node_levels` remains
+    as the golden recomputation, parity-pinned in
+    tests/test_incremental_caches.py). `compute_levels=False` fills the
+    padding value instead; only the Decima GNN reads `node_level`."""
     job_mask = state.job_active
     node_mask = (
         job_mask[:, None] & state.stage_exists & ~state.stage_completed
@@ -70,7 +72,9 @@ def observe(
     )
     nodes = jnp.where(node_mask[:, :, None], nodes, 0.0)
     if compute_levels:
-        node_level = _core.compute_node_levels(params, state)
+        node_level = jnp.where(
+            node_mask, state.node_level, node_mask.shape[1]
+        )
     else:
         node_level = jnp.full(
             node_mask.shape, node_mask.shape[1], jnp.int32

@@ -45,6 +45,27 @@ INF = np.float32(np.inf)
 BIG_SEQ = np.int32(2**30)
 
 
+def topo_levels(active: jnp.ndarray, adj_act: jnp.ndarray) -> jnp.ndarray:
+    """i32[J,S] topological generation of each active node in the masked
+    subgraph; padding = S. Matches nx.topological_generations on the
+    observed dag batch (reference decima/utils.py:238-267). Lives here —
+    the leaf module — so the env core, the observation path and the
+    golden `node_level_golden` property all share ONE copy of the
+    reduction (core re-exports it)."""
+    from jax import lax
+
+    s_cap = active.shape[1]
+
+    def body(_, lvl):
+        cand = jnp.where(adj_act, lvl[:, :, None] + 1, 0).max(axis=1)
+        return jnp.maximum(lvl, cand)
+
+    lvl = lax.fori_loop(
+        0, s_cap, body, jnp.zeros(active.shape, jnp.int32)
+    )
+    return jnp.where(active, lvl, s_cap)
+
+
 class EnvState(struct.PyTreeNode):
     # --- rng / time ---
     rng: jnp.ndarray
@@ -106,6 +127,18 @@ class EnvState(struct.PyTreeNode):
     stage_sat: jnp.ndarray  # bool[J,S]; exec_demand <= 0
     unsat_parent_count: jnp.ndarray  # i32[J,S]; parents with ~sat & exists
     incomplete_parent_count: jnp.ndarray  # i32[J,S]; parents not completed
+
+    # --- incremental node-level cache [J,S] ---
+    # per-job topological generations over the job's existing, incomplete
+    # stages (padding = max_stages), maintained at the ONLY mutation point
+    # that changes a job's active subgraph — stage completion in
+    # `_handle_task_finished` (bulk passes never complete a stage) — by a
+    # depth-bounded single-job [S,S] pass. Replaces the per-observation
+    # S-deep [J,S,S] reduction (`compute_node_levels`, the documented most
+    # expensive part of `observe`); job arrival/termination need no
+    # recompute because the cache ignores `job_active` and the observation
+    # masks with `node_mask`. Golden recomputation: `node_level_golden`.
+    node_level: jnp.ndarray  # i32[J,S]
 
     # --- incremental executor-flow counters [J,S] ---
     # the reference maintains these as dicts (_num_commitments_to_stage /
@@ -175,6 +208,15 @@ class EnvState(struct.PyTreeNode):
         incomplete_parent = self.adj & ~self.stage_completed[:, :, None]
         blocked = incomplete_parent.any(axis=1)
         return self.stage_exists & ~self.stage_completed & ~blocked
+
+    @property
+    def node_level_golden(self) -> jnp.ndarray:
+        """Recomputed per-job topological generations over existing,
+        incomplete stages — the golden version of the incremental
+        `node_level` field (the shared `topo_levels` reduction above)."""
+        active = self.stage_exists & ~self.stage_completed
+        adj_act = self.adj & active[:, :, None] & active[:, None, :]
+        return topo_levels(active, adj_act)
 
     @property
     def commit_count_to_stage(self) -> jnp.ndarray:
@@ -311,6 +353,7 @@ def empty_state(params: EnvParams, rng: jax.Array) -> EnvState:
         stage_sat=jnp.ones((j, s), bool),
         unsat_parent_count=jnp.zeros((j, s), i32),
         incomplete_parent_count=jnp.zeros((j, s), i32),
+        node_level=jnp.full((j, s), s, i32),
         commit_count=jnp.zeros((j, s), i32),
         moving_count=jnp.zeros((j, s), i32),
         cm_valid=jnp.zeros(n, bool),

@@ -20,6 +20,7 @@ become fixed-shape [max_jobs, max_stages] arrays with masks:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable
 
 import jax
@@ -106,6 +107,65 @@ def build_features(
         adj=adj,
         node_level=obs.node_level,
     )
+
+
+# --------------------------------------------------------------------------
+# active-job compaction (round-8 fast path)
+#
+# The reference only ever embeds the arrived, incomplete jobs (its PyG
+# batch is built from live DAGs; scheduler.py:219-232), while the dense
+# padded port pays the full [J,S,S]@[S,D] level einsum over every padded
+# job slot. These helpers gather the <=K active jobs into a width-K view,
+# run the (shape-polymorphic) net at width K, and scatter the per-job
+# scores back to the padded [J] layout before masked softmax — cutting
+# GNN FLOPs and memory traffic by ~J/K at flagship shapes (J=200 cap,
+# typically a few dozen live jobs). All per-job computations are
+# independent except the global summary, which sums over job_mask only,
+# so compact and full-width scores agree on every active job.
+# --------------------------------------------------------------------------
+
+
+def compact_features(
+    f: DecimaFeatures, k: int
+) -> tuple[DecimaFeatures, jnp.ndarray]:
+    """Gather the first `k` active jobs of an unbatched [J,...] feature
+    set into a width-k view. Returns (compact features, ids) where
+    `ids[i]` is the padded job id behind compact row i (== j_cap for
+    empty rows). Only meaningful when the number of active jobs is <= k;
+    callers guard with the overflow cond in `DecimaScheduler.score`."""
+    j_cap = f.job_mask.shape[0]
+    # active ids are the smallest entries of this ascending sort, so
+    # rows 0..num_active-1 are exactly the active jobs in id order
+    ids = jnp.sort(jnp.where(f.job_mask, jnp.arange(j_cap), j_cap))[:k]
+    valid = ids < j_cap
+    idx = jnp.minimum(ids, j_cap - 1)  # clamp gathers for empty rows
+    vm = valid[:, None]
+    node_mask = f.node_mask[idx] & vm
+    return DecimaFeatures(
+        x=jnp.where(node_mask[..., None], f.x[idx], 0.0),
+        node_mask=node_mask,
+        job_mask=valid,
+        stage_mask=f.stage_mask[idx] & vm,
+        exec_mask=f.exec_mask[idx] & vm,
+        adj=f.adj[idx] & vm[:, :, None],
+        node_level=f.node_level[idx],
+    ), ids
+
+
+def scatter_job_scores(
+    stage_k: jnp.ndarray, exec_k: jnp.ndarray, ids: jnp.ndarray,
+    j_cap: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter compact [k,S]/[k,N] scores back to the padded [J,S]/[J,N]
+    layout (rows of inactive jobs are zero — the masked softmax never
+    reads them). Empty compact rows carry ids == j_cap and drop."""
+    stage = jnp.zeros(
+        (j_cap,) + stage_k.shape[1:], stage_k.dtype
+    ).at[ids].set(stage_k, mode="drop")
+    execs = jnp.zeros(
+        (j_cap,) + exec_k.shape[1:], exec_k.dtype
+    ).at[ids].set(exec_k, mode="drop")
+    return stage, execs
 
 
 # --------------------------------------------------------------------------
@@ -243,9 +303,18 @@ class DecimaNet(nn.Module):
             variable_broadcast="params",
             split_rngs={"params": False},
         )(self, h0, levels)
-        # reference fast path when the whole batch has no edges
-        # (scheduler.py:205-207,236-241): plain prep(x), no update()
-        h_node = jnp.where(f.adj.any(), h_node, h_init)
+        # reference fast path for an observation with no edges
+        # (scheduler.py:205-207,236-241): plain prep(x), no update().
+        # Reduced per ITEM (last 3 axes), not over leading batch dims:
+        # a vmapped per-lane policy traces the unbatched reduction, so
+        # the genuinely-batched callers (batch_policy / the single-eval
+        # collectors) must do the same per-lane or the two paths'
+        # scores diverge on edgeless observations sharing a batch with
+        # edged ones.
+        edgeless = ~f.adj.any(axis=(-3, -2, -1))
+        h_node = jnp.where(
+            edgeless[..., None, None, None], h_init, h_node
+        )
         h_node = jnp.where(f.node_mask[..., None], h_node, 0.0)
 
         # --- DagEncoder (reference scheduler.py:244-257) ---
@@ -427,12 +496,17 @@ class DecimaScheduler(TrainableScheduler):
         work_scale: float = 1e5,
         compute_dtype: str | None = None,
         num_levels: int = 0,
+        job_bucket: int = 0,
         **_: Any,
     ) -> None:
         self.name = "Decima"
         self.num_executors = int(num_executors)
         self.num_tasks_scale = num_tasks_scale
         self.work_scale = work_scale
+        # active-job compaction bucket K (0 = off): `score` runs the GNN
+        # at width K when every item has <= K active jobs, with a
+        # scalar-predicate full-width fallback (see `score`'s docstring)
+        self.job_bucket = int(job_bucket)
         gnn_mlp_kwargs = gnn_mlp_kwargs or {}
         policy_mlp_kwargs = policy_mlp_kwargs or {}
         self.net = DecimaNet(
@@ -473,6 +547,39 @@ class DecimaScheduler(TrainableScheduler):
             obs, self.num_executors, self.num_tasks_scale, self.work_scale
         )
 
+    # -- scoring (compaction-aware) ----------------------------------------
+    def score(self, params, f: DecimaFeatures):
+        """Stage/exec scores for padded features `f` — unbatched [J,...]
+        or with any number of leading batch axes. With `job_bucket` K > 0
+        the <=K active jobs are gathered into a width-K view, the net
+        runs at width K, and the scores scatter back to [J] (identical
+        values on active jobs — per-job computations are independent and
+        the global summary sums over job_mask only). The full-width
+        fallback runs under a lax.cond whose predicate reduces over ALL
+        leading axes to a scalar: batched callers (the single-eval flat
+        collectors, bench) execute exactly one branch at runtime —
+        unlike a per-lane cond, which jax's batching rule lowers to
+        executing both branches for every lane."""
+        k = self.job_bucket
+        j_cap = f.job_mask.shape[-1]
+        if not k or k >= j_cap:
+            return self.net.apply(params, f)
+        overflow = (f.job_mask.sum(-1) > k).any()
+
+        def full(f):
+            return self.net.apply(params, f)
+
+        def compact(f):
+            cf = partial(compact_features, k=k)
+            sc = partial(scatter_job_scores, j_cap=j_cap)
+            for _ in range(f.job_mask.ndim - 1):
+                cf, sc = jax.vmap(cf), jax.vmap(sc)
+            fk, ids = cf(f)
+            ss, es = self.net.apply(params, fk)
+            return sc(ss, es, ids)
+
+        return jax.lax.cond(overflow, full, compact, f)
+
     # -- pure policy (vmap/scan-safe) -------------------------------------
     def policy(self, rng: jax.Array, obs: Observation, params=None,
                deterministic: bool = False):
@@ -481,11 +588,37 @@ class DecimaScheduler(TrainableScheduler):
         params = self.params if params is None else params
         f = self.features(obs)
         with annotate("decima/gnn"):
-            stage_scores, exec_scores = self.net.apply(params, f)
+            stage_scores, exec_scores = self.score(params, f)
         action, lgprob = sample_action(
             rng, stage_scores, exec_scores, f, deterministic
         )
         # env takes a 1-based executor count (reference env_wrapper.py:33-34)
+        return action.stage_idx, action.num_exec + 1, {
+            "lgprob": lgprob,
+            "job_idx": action.job_idx,
+            "num_exec_k": action.num_exec,
+        }
+
+    # -- batched policy (single GNN eval over a lane stack) ----------------
+    def batch_policy(self, rng: jax.Array, obs: Observation, params=None,
+                     deterministic: bool = False):
+        """Policy over a [B]-leading Observation stack in ONE net
+        evaluation, with the compaction cond at batch level (scalar
+        predicate — one branch executes at runtime). `rng` is a single
+        key, split per lane internally. Returns per-lane
+        (stage_idx[B], num_exec_1based[B], aux-of-[B])."""
+        from ..obs.tracing import annotate
+
+        params = self.params if params is None else params
+        f = jax.vmap(self.features)(obs)
+        with annotate("decima/gnn"):
+            stage_scores, exec_scores = self.score(params, f)
+        keys = jax.random.split(rng, f.job_mask.shape[0])
+        action, lgprob = jax.vmap(
+            lambda r, ss, es, ff: sample_action(
+                r, ss, es, ff, deterministic
+            )
+        )(keys, stage_scores, exec_scores, f)
         return action.stage_idx, action.num_exec + 1, {
             "lgprob": lgprob,
             "job_idx": action.job_idx,
@@ -505,6 +638,18 @@ class DecimaScheduler(TrainableScheduler):
 
         def policy_fn(rng, obs):
             return self.policy(rng, obs, p, deterministic)
+
+        return policy_fn
+
+    def flat_batch_policy(self, params=None, deterministic: bool = False):
+        """Batched analog of `flat_policy` for the single-eval flat
+        collectors (`trainers/rollout.py:collect_flat_sync_batch`): one
+        `batch_policy` call per decision row over the whole lane stack,
+        so the compaction cond stays scalar (see `score`)."""
+        p = self.params if params is None else params
+
+        def policy_fn(rng, obs):
+            return self.batch_policy(rng, obs, p, deterministic)
 
         return policy_fn
 

@@ -32,6 +32,8 @@ from ..env.flat_loop import (
     M_DECIDE,
     LoopState,
     aux_action_fields,
+    decide_micro_step,
+    drain_to_decision,
     event_micro_step,
     init_loop_state,
     micro_step,
@@ -637,6 +639,334 @@ def collect_flat_sync(
         use_elapsed=False, telemetry=telemetry,
     )
     return (out[0], out[2]) if telemetry is not None else out[0]
+
+
+# ---------------------------------------------------------------------------
+# single-eval flat collection (round 8)
+#
+# The per-lane collectors above run `micro_step(record=True)`, which
+# evaluates observe+policy on EVERY full micro-step group — at the
+# round-6 calibrations that measured ~2 GNN evaluations per recorded
+# decision (the DECIDE group's eval plus the wasted eval of each group
+# that lands on a FULFILL/EVENT lane). The collectors below restructure
+# the scan so ONE policy evaluation is both acted on and recorded per
+# decision row:
+#
+#   scan iteration k == decision k:
+#     observe -> batch_policy (ONE eval over the [B] lane stack, with
+#     the Decima job-compaction cond at batch level) ->
+#     vmap(decide_micro_step) (acts on + records the same outputs) ->
+#     vmap(drain_to_decision) (non-policy micro-steps until every lane
+#     is at its next decision)
+#
+# The drain reintroduces a batch-max while-loop between decisions — but
+# only over the cheap env machinery (bulk passes + pops); the GNN, the
+# measured 70-90% of the Decima decision row, runs exactly once per
+# decision (test-pinned by a counting-policy test in
+# tests/test_flat_loop.py). Collected quantities remain step-exact vs
+# the `core.step` path.
+# ---------------------------------------------------------------------------
+
+
+# batch policy: policy_fn(rng, obs_with_leading_B_axis) -> per-lane
+# (stage_idx[B], num_exec[B], aux-of-[B]) from ONE evaluation — see
+# DecimaScheduler.batch_policy / flat_batch_policy.
+BatchPolicyFn = Callable[[jax.Array, Observation], tuple]
+
+
+def _flat_collect_single_eval(
+    params: EnvParams,
+    bank: WorkloadBank,
+    batch_policy_fn: BatchPolicyFn,
+    rng: jax.Array,
+    num_steps: int,
+    ls: LoopState,  # [B]-batched
+    auto_reset: bool,
+    event_bulk: bool,
+    bulk_events: int,
+    fulfill_bulk: bool,
+    bulk_cycles: int,
+    reset_fns,  # None, or a per-lane factory: lane_idx -> reset_fn
+    rollout_duration,
+    use_elapsed: bool,
+    telemetry=None,
+):
+    """Shared single-eval collection scan over the WHOLE lane batch
+    (`ls` carries a leading [B] axis; no outer vmap). Exactly
+    `num_steps` scan iterations, each producing at most one decision
+    per lane; see the section comment above for the shape."""
+    track = telemetry is not None
+    T = num_steps
+    B = ls.mode.shape[0]
+    s_cap = params.max_stages
+    zs = _zero_stored(params)
+    buf0 = _FlatBuf(
+        obs=jax.tree_util.tree_map(
+            lambda a: jnp.zeros((B, T) + a.shape, a.dtype), zs
+        ),
+        stage_idx=jnp.zeros((B, T), _i32),
+        job_idx=jnp.zeros((B, T), _i32),
+        num_exec_k=jnp.zeros((B, T), _i32),
+        lgprob=jnp.zeros((B, T), jnp.float32),
+        reward=jnp.zeros((B, T), jnp.float32),
+        walls=jnp.zeros((B, T), jnp.float32),
+        resets=jnp.zeros((B, T), _i32),
+    )
+    lane_idx = jnp.arange(B)
+
+    def v_decide(ls, si, ne, keys, li, tm):
+        def one(l, s_, n_, k_, i_, t_):
+            rf = None if reset_fns is None else reset_fns(i_)
+            return decide_micro_step(
+                params, bank, l, s_, n_, k_, auto_reset, fulfill_bulk,
+                reset_fn=rf, telemetry=t_,
+            )
+
+        return jax.vmap(one)(ls, si, ne, keys, li, tm)
+
+    def v_drain(ls, keys, li, t_ref, tm):
+        def one(l, k_, i_, tr, t_):
+            rf = None if reset_fns is None else reset_fns(i_)
+            return drain_to_decision(
+                params, bank, l, k_, auto_reset, event_bulk,
+                bulk_events, bulk_cycles, reset_fn=rf, t_ref=tr,
+                telemetry=t_,
+            )
+
+        return jax.vmap(one)(ls, keys, li, t_ref, tm)
+
+    def body(carry, _):
+        if track:
+            ls, k, t_ref, elapsed, ndec, buf, tm = carry
+        else:
+            (ls, k, t_ref, elapsed, ndec, buf), tm = carry, None
+        tm_frozen = tm
+        k, k_pol, k_dec, k_drain = jax.random.split(k, 4)
+        env0 = ls.env
+        wall0 = env0.wall_time  # [B]
+        if rollout_duration is not None:
+            over = elapsed >= rollout_duration
+        else:
+            over = jnp.zeros((B,), bool)
+
+        # THE policy evaluation of this decision row (batch-level: one
+        # net application, compaction cond on a scalar predicate)
+        obs = jax.vmap(lambda e: observe(params, e))(env0)
+        stage_idx, num_exec, aux = batch_policy_fn(k_pol, obs)
+        lgprob, job, kk = aux_action_fields(
+            aux, stage_idx, num_exec, s_cap
+        )
+        # heuristic batch policies may omit lgprob (scalar default);
+        # the per-lane buffer scatters need a [B] leading axis
+        lgprob = jnp.broadcast_to(
+            jnp.asarray(lgprob, jnp.float32), stage_idx.shape
+        )
+
+        out = v_decide(
+            ls, stage_idx, num_exec, jax.random.split(k_dec, B),
+            lane_idx, tm,
+        )
+        if track:
+            ls2, (decided, rw1, dt1, rs1), tm = out
+        else:
+            ls2, (decided, rw1, dt1, rs1) = out
+        # discount reference for the span this decision opens (the
+        # decide micro-step itself never advances the wall clock)
+        t_ref2 = jnp.where(decided & ~over, wall0, t_ref)
+
+        out = v_drain(
+            ls2, jax.random.split(k_drain, B), lane_idx, t_ref2, tm
+        )
+        if track:
+            ls3, (rw2, dt2, rs2), tm = out
+        else:
+            ls3, (rw2, dt2, rs2) = out
+        reward = rw1 + rw2
+        dt = dt1 + dt2
+        reset = rs1 | rs2
+
+        # frozen lanes (async budget exhausted): state untouched,
+        # nothing recorded
+        ls3 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(
+                over.reshape(over.shape + (1,) * (a.ndim - 1)), a, b
+            ),
+            ls, ls3,
+        )
+        if track:
+            tm = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(over, a, b), tm_frozen, tm
+            )
+        zero = jnp.float32(0.0)
+        reward = jnp.where(over, zero, reward)
+        dt = jnp.where(over, zero, dt)
+        reset = reset & ~over
+        dec = decided & ~over
+
+        with annotate("collect/scatter"):
+            slot = jnp.where(dec & (ndec < T), ndec, T)
+            stored = jax.vmap(store_obs)(obs, env0)
+            set_at = lambda b, s, v: b.at[s].set(v, mode="drop")  # noqa: E731
+            buf = buf.replace(
+                obs=jax.tree_util.tree_map(
+                    lambda b, v: jax.vmap(set_at)(b, slot, v),
+                    buf.obs, stored,
+                ),
+                stage_idx=jax.vmap(set_at)(buf.stage_idx, slot, stage_idx),
+                job_idx=jax.vmap(set_at)(buf.job_idx, slot, job),
+                num_exec_k=jax.vmap(set_at)(buf.num_exec_k, slot, kk),
+                lgprob=jax.vmap(set_at)(buf.lgprob, slot, lgprob),
+                walls=jax.vmap(set_at)(
+                    buf.walls, slot, elapsed if use_elapsed else wall0
+                ),
+            )
+            ndec2 = ndec + dec.astype(_i32)
+            # span rewards belong to the most recent decision's slot;
+            # spans before a resumed lane's first decision drop
+            rslot = jnp.where((ndec2 > 0) & (ndec2 <= T), ndec2 - 1, T)
+            buf = buf.replace(
+                reward=jax.vmap(
+                    lambda b, s, v: b.at[s].add(v, mode="drop")
+                )(buf.reward, rslot, reward),
+                resets=jax.vmap(
+                    lambda b, s, v: b.at[s].max(v, mode="drop")
+                )(buf.resets, rslot, reset.astype(_i32)),
+            )
+        carry = (ls3, k, t_ref2, elapsed + dt, ndec2, buf)
+        return (carry + (tm,) if track else carry), None
+
+    carry0 = (
+        ls, rng, ls.env.wall_time, jnp.zeros((B,), jnp.float32),
+        jnp.zeros((B,), _i32), buf0,
+    )
+    if track:
+        carry0 = carry0 + (telemetry,)
+    carry, _ = lax.scan(body, carry0, None, length=T)
+    ls, elapsed, ndec, buf = carry[0], carry[3], carry[4], carry[5]
+    if track:
+        telemetry = carry[6]
+
+    valid = jnp.arange(T)[None, :] < jnp.minimum(ndec, T)[:, None]
+    final_t = elapsed if use_elapsed else ls.env.wall_time
+    walls = jnp.where(valid, buf.walls, final_t[:, None])
+    ro = Rollout(
+        obs=buf.obs,
+        stage_idx=jnp.where(valid, buf.stage_idx, -1),
+        job_idx=buf.job_idx,
+        num_exec_k=buf.num_exec_k,
+        lgprob=buf.lgprob,
+        reward=buf.reward,
+        wall_times=jnp.concatenate([walls, final_t[:, None]], axis=1),
+        valid=valid,
+        resets=buf.resets > 0,
+        final_state=ls.env,
+        final_reset_count=ls.episodes,
+    )
+    return (ro, ls, telemetry) if track else (ro, ls)
+
+
+@partial(
+    jax.jit, static_argnums=(0, 2, 4),
+    static_argnames=(
+        "event_bulk", "bulk_events", "fulfill_bulk", "bulk_cycles",
+    ),
+)
+def collect_flat_sync_batch(
+    params: EnvParams,
+    bank: WorkloadBank,
+    batch_policy_fn: BatchPolicyFn,
+    rng: jax.Array,
+    num_steps: int,
+    states: EnvState,  # [B]-batched, freshly reset
+    telemetry=None,
+    *,
+    event_bulk: bool = True,
+    bulk_events: int = 8,
+    fulfill_bulk: bool = True,
+    bulk_cycles: int = 1,
+) -> Rollout | tuple:
+    """Single-eval flat equivalent of `vmap(collect_sync)`: one episode
+    per lane from the given freshly-reset [B] states, exactly one policy
+    evaluation per decision row (no `micro_groups` sizing — the scan
+    length IS `num_steps`). With `telemetry` ([B]-leading), returns
+    `(Rollout, Telemetry)`."""
+    ls = jax.vmap(init_loop_state)(states)
+    out = _flat_collect_single_eval(
+        params, bank, batch_policy_fn, rng, num_steps, ls,
+        auto_reset=False, event_bulk=event_bulk,
+        bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
+        bulk_cycles=bulk_cycles, reset_fns=None, rollout_duration=None,
+        use_elapsed=False, telemetry=telemetry,
+    )
+    return (out[0], out[2]) if telemetry is not None else out[0]
+
+
+@partial(
+    jax.jit, static_argnums=(0, 2, 4),
+    static_argnames=(
+        "event_bulk", "bulk_events", "fulfill_bulk", "bulk_cycles",
+    ),
+)
+def collect_flat_async_batch(
+    params: EnvParams,
+    bank: WorkloadBank,
+    batch_policy_fn: BatchPolicyFn,
+    rng: jax.Array,
+    num_steps: int,
+    loop_states: LoopState,  # [B]-batched
+    rollout_duration: jnp.ndarray | float = jnp.inf,
+    seq_bases: jax.Array | None = None,  # [B] keys
+    lane_salts: jnp.ndarray | int = 0,  # [B]
+    reset_counts: jnp.ndarray | int = 0,  # [B]
+    telemetry=None,
+    *,
+    event_bulk: bool = True,
+    bulk_events: int = 8,
+    fulfill_bulk: bool = True,
+    bulk_cycles: int = 1,
+) -> tuple:
+    """Single-eval flat equivalent of `vmap(collect_flat_async)`:
+    persistent [B] lanes, fixed sim-time budget, group-shared mid-scan
+    reset sequences from `fold_in(seq_bases[i], reset_counts[i] +
+    completed_episodes)`. Budget granularity is the decision row (the
+    same as `collect_async`). Returns `(Rollout, LoopState[,
+    Telemetry])`."""
+    rollout_duration = jnp.float32(rollout_duration)
+    B = loop_states.mode.shape[0]
+    if seq_bases is None:
+        seq_bases = jax.random.split(rng, B)
+    lane_salts = jnp.broadcast_to(
+        jnp.asarray(lane_salts, _i32), (B,)
+    )
+    reset_counts = jnp.broadcast_to(
+        jnp.asarray(reset_counts, _i32), (B,)
+    )
+    loop_states = loop_states.replace(episodes=jnp.zeros((B,), _i32))
+
+    def reset_fns(lane_idx):
+        def reset_fn(key, episodes):
+            seq_rng = jax.random.fold_in(
+                seq_bases[lane_idx], reset_counts[lane_idx] + episodes
+            )
+            return core.reset_pair(
+                params, bank, seq_rng,
+                jax.random.fold_in(seq_rng, lane_salts[lane_idx]),
+            )
+
+        return reset_fn
+
+    out = _flat_collect_single_eval(
+        params, bank, batch_policy_fn, rng, num_steps, loop_states,
+        auto_reset=True, event_bulk=event_bulk, bulk_events=bulk_events,
+        fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
+        reset_fns=reset_fns, rollout_duration=rollout_duration,
+        use_elapsed=True, telemetry=telemetry,
+    )
+    ro, ls = out[0], out[1]
+    ro = ro.replace(final_reset_count=reset_counts + ls.episodes)
+    if telemetry is not None:
+        return ro, ls, out[2]
+    return ro, ls
 
 
 @partial(

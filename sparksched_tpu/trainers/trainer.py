@@ -60,7 +60,9 @@ from .rollout import (
     Rollout,
     collect_async,
     collect_flat_async,
+    collect_flat_async_batch,
     collect_flat_sync,
+    collect_flat_sync_batch,
     collect_sync,
     flat_micro_group_budget,
 )
@@ -237,25 +239,25 @@ class Trainer(abc.ABC):
                 f"rollout_engine must be 'core' or 'flat', got "
                 f"{self.rollout_engine!r}"
             )
+        # single-eval flat collection (round 8, default on): the scan is
+        # decision-synchronous — ONE batched policy evaluation per
+        # decision row (vs ~2 per decision measured on the per-lane
+        # micro-step-group collectors), with the Decima job-compaction
+        # cond at batch level. Requires a scheduler exposing
+        # `flat_batch_policy`; set `flat_single_eval: false` to fall
+        # back to the round-6 per-lane group collectors.
+        self.flat_single_eval: bool = bool(
+            train_cfg.get("flat_single_eval", True)
+        )
         # micro-step-group budget per decision: the scan runs
         # rollout_steps * this many groups (PERF.md mode census: ~3
         # micro-steps per decision in steady state; 4 adds headroom)
         self.flat_micro_per_decision: float = float(
             train_cfg.get("flat_micro_per_decision", 4.0)
         )
-        self.flat_knobs = {
-            "event_burst": int(train_cfg.get("flat_event_burst", 1)),
-            "event_bulk": bool(train_cfg.get("flat_event_bulk", True)),
-            "bulk_events": int(train_cfg.get("flat_bulk_events", 8)),
-            "fulfill_bulk": bool(
-                train_cfg.get("flat_fulfill_bulk", False)
-            ),
-            "bulk_cycles": int(train_cfg.get("flat_bulk_cycles", 1)),
-        }
-        self.flat_micro_groups: int = flat_micro_group_budget(
-            self.rollout_steps, self.flat_micro_per_decision,
-            self.flat_knobs["event_burst"],
-        )
+        # the flat knob dicts are built AFTER the scheduler exists: the
+        # single-eval capability check may downgrade flat_single_eval,
+        # and fulfill_bulk's default follows the final mode
 
         # bound the Decima level scan by the bank's true max DAG depth
         # (bit-identical — deeper levels are no-op updates — and the
@@ -280,6 +282,37 @@ class Trainer(abc.ABC):
             "scheduler must be trainable"
         )
         self.scheduler: TrainableScheduler = scheduler
+        # single-eval collection calls scheduler.batch_policy (one
+        # batched evaluation per decision row); schedulers without it
+        # fall back to the per-lane group collectors
+        self.flat_single_eval = self.flat_single_eval and hasattr(
+            scheduler, "batch_policy"
+        )
+        self.flat_knobs = {
+            "event_burst": int(train_cfg.get("flat_event_burst", 1)),
+            "event_bulk": bool(train_cfg.get("flat_event_bulk", True)),
+            "bulk_events": int(train_cfg.get("flat_bulk_events", 8)),
+            # single-eval mode defaults fulfill bulking ON: leftovers
+            # otherwise cost one drain iteration each, and the pass's
+            # op count rides the already-GNN-dominated decision row.
+            # The default follows the FINAL mode (post capability
+            # check), so a per-lane fallback keeps its round-6 False.
+            "fulfill_bulk": bool(
+                train_cfg.get("flat_fulfill_bulk",
+                              self.flat_single_eval)
+            ),
+            "bulk_cycles": int(train_cfg.get("flat_bulk_cycles", 1)),
+        }
+        # the batch (single-eval) collectors take no event_burst —
+        # bursts amortized the policy eval the restructure removed
+        self.flat_batch_knobs = {
+            k: v for k, v in self.flat_knobs.items()
+            if k != "event_burst"
+        }
+        self.flat_micro_groups: int = flat_micro_group_budget(
+            self.rollout_steps, self.flat_micro_per_decision,
+            self.flat_knobs["event_burst"],
+        )
         self.tx = make_optimizer(train_cfg)
         self.train_cfg = train_cfg
         self._env_states = None  # async mode: persistent lanes
@@ -367,6 +400,10 @@ class Trainer(abc.ABC):
             return self.scheduler.policy(k, obs, model_params)
 
         flat = self.rollout_engine == "flat"
+        single = flat and self.flat_single_eval
+        if single:
+            def batch_policy_fn(k, obs):
+                return self.scheduler.batch_policy(k, obs, model_params)
         if self.rollout_duration:  # async mode
             if env_states is None:
                 states = jax.vmap(
@@ -390,6 +427,18 @@ class Trainer(abc.ABC):
             # (the collector's return shape switches on the Python-level
             # None check at trace time)
             track = telem0 is not None
+            if single:
+                out = collect_flat_async_batch(
+                    p, bank, batch_policy_fn,
+                    jax.random.fold_in(rng, 7), self.rollout_steps,
+                    states, self.rollout_duration, seq_bases,
+                    lane_salts, reset_counts, telem0,
+                    **self.flat_batch_knobs,
+                )
+                ro, loop_states, telem = (
+                    out if track else (out + (None,))
+                )
+                return ro, (loop_states, ro.final_reset_count), telem
             if flat:
                 out = jax.vmap(
                     lambda k, s, sb, salt, rc, tm: collect_flat_async(
@@ -418,7 +467,13 @@ class Trainer(abc.ABC):
                 lambda s, l: core.reset_pair(p, bank, s, l)
             )(seq_rngs, lane_rngs)
             track = telem0 is not None
-            if flat:
+            if single:
+                out = collect_flat_sync_batch(
+                    p, bank, batch_policy_fn,
+                    jax.random.fold_in(rng, 7), self.rollout_steps,
+                    states, telem0, **self.flat_batch_knobs,
+                )
+            elif flat:
                 out = jax.vmap(
                     lambda k, s, tm: collect_flat_sync(
                         p, bank, policy_fn, k, self.rollout_steps, s, tm,

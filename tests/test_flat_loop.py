@@ -496,6 +496,194 @@ def test_flat_decima_collection_matches_core_step_path(monkeypatch):
     )
 
 
+def _decima_parity_fixture(monkeypatch):
+    """Shared fixture for the Decima collection-parity tests: pins the
+    duration sampler deterministic (the engines' rng STREAMS
+    legitimately differ) and builds a greedy Decima scheduler, so every
+    compared quantity is rng-independent."""
+    import jax.numpy as jnp
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.schedulers import DecimaScheduler
+    from sparksched_tpu.workload import make_workload_bank
+
+    def det_sampler(params, bank, rng, template, stage, num_local,
+                    task_valid, same_stage):
+        base = bank.rough_duration[template, stage]
+        return (
+            base
+            + jnp.where(task_valid & same_stage, 7.0, 131.0)
+            + 17.0 * stage.astype(jnp.float32)
+        )
+
+    monkeypatch.setattr(core, "sample_task_duration", det_sampler)
+
+    params = EnvParams(
+        num_executors=5, max_jobs=6, max_stages=20, max_levels=20,
+        moving_delay=700.0, warmup_delay=500.0, job_arrival_rate=4e-5,
+        mean_time_limit=None, beta=5e-3,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+
+    def make_sched(**kw):
+        return DecimaScheduler(
+            num_executors=params.num_executors, embed_dim=8,
+            gnn_mlp_kwargs={"hid_dims": [16, 8], "act_cls": "LeakyReLU",
+                            "act_kwargs": {"negative_slope": 0.2}},
+            policy_mlp_kwargs={"hid_dims": [16, 16], "act_cls": "Tanh"},
+            seed=7, **kw,
+        )
+
+    return params, bank, make_sched
+
+
+def _assert_rollouts_match(ro_core, ro_flat, lane=None):
+    """Step-exact comparison of an unbatched core Rollout against (one
+    lane of) a possibly-batched flat Rollout."""
+    import numpy as np_
+
+    def a(x):
+        return np_.asarray(x)
+
+    def b(x):
+        return np_.asarray(x)[lane] if lane is not None else np_.asarray(x)
+
+    nv = int(a(ro_core.valid).sum())
+    assert nv > 30, "fixture episode too short to be meaningful"
+    np_.testing.assert_array_equal(a(ro_core.valid), b(ro_flat.valid))
+    np_.testing.assert_array_equal(
+        a(ro_core.stage_idx), b(ro_flat.stage_idx)
+    )
+    for name in ("job_idx", "num_exec_k"):
+        np_.testing.assert_array_equal(
+            a(getattr(ro_core, name))[:nv],
+            b(getattr(ro_flat, name))[:nv],
+            err_msg=name,
+        )
+    np_.testing.assert_allclose(
+        a(ro_core.lgprob)[:nv], b(ro_flat.lgprob)[:nv],
+        rtol=1e-5, atol=1e-6,
+    )
+    np_.testing.assert_allclose(
+        a(ro_core.reward), b(ro_flat.reward), rtol=1e-4, atol=1e-4
+    )
+    np_.testing.assert_allclose(
+        a(ro_core.wall_times), b(ro_flat.wall_times), rtol=1e-6
+    )
+    for name in ("remaining", "duration", "schedulable", "node_mask",
+                 "job_mask", "job_template", "exec_supplies",
+                 "num_committable", "source_job"):
+        np_.testing.assert_array_equal(
+            a(getattr(ro_core.obs, name))[:nv],
+            b(getattr(ro_flat.obs, name))[:nv],
+            err_msg=f"stored obs field {name}",
+        )
+
+
+@pytest.mark.parametrize("job_bucket", [0, 3])
+def test_single_eval_flat_collection_matches_core_step_path(
+    monkeypatch, job_bucket
+):
+    """Round-8 tentpole parity: the single-eval batch collector
+    (`collect_flat_sync_batch` — one batched policy evaluation per
+    decision row, decide micro-step + drain-to-decision) must agree
+    step-exactly with the per-decision `core.step` collection path at
+    fixed seeds, with and without active-job compaction (job_bucket=3
+    exercises the compact GNN on <=3-active rows AND the full-width
+    fallback when more jobs are live)."""
+    import jax
+
+    from sparksched_tpu.env import core
+    from sparksched_tpu.trainers.rollout import (
+        collect_flat_sync_batch,
+        collect_sync,
+    )
+
+    params, bank, make_sched = _decima_parity_fixture(monkeypatch)
+    sched = make_sched(job_bucket=job_bucket)
+    pol = sched.flat_policy(deterministic=True)
+    bpol = sched.flat_batch_policy(deterministic=True)
+
+    T = 160
+    keys = [jax.random.PRNGKey(3), jax.random.PRNGKey(5)]
+    states = [core.reset(params, bank, k) for k in keys]
+    ro_cores = [
+        collect_sync(params, bank, pol, jax.random.PRNGKey(0), T, s)
+        for s in states
+    ]
+    batched = jax.tree_util.tree_map(
+        lambda *a: jax.numpy.stack(a), *states
+    )
+    ro_flat = collect_flat_sync_batch(
+        params, bank, bpol, jax.random.PRNGKey(1), T, batched,
+        fulfill_bulk=True,
+    )
+    for lane, ro_core in enumerate(ro_cores):
+        _assert_rollouts_match(ro_core, ro_flat, lane=lane)
+        np.testing.assert_allclose(
+            float(np.asarray(ro_core.final_state.wall_time)),
+            float(np.asarray(ro_flat.final_state.wall_time)[lane]),
+            rtol=1e-6,
+        )
+
+
+def test_single_eval_flat_collection_one_policy_eval_per_decide(
+    monkeypatch,
+):
+    """Acceptance pin: flat single-eval collection performs EXACTLY one
+    policy evaluation per recorded decision row. The counting wrapper
+    bumps a host counter via io_callback on every actual execution of
+    the policy program; with B lanes and T decisions per lane the batch
+    collector must evaluate T times total (one batched eval per row) —
+    the per-lane group collector measured ~2 per decision (PERF.md
+    round 6)."""
+    import jax
+
+    from sparksched_tpu.env import core
+    from sparksched_tpu.trainers.rollout import collect_flat_sync_batch
+
+    params, bank, make_sched = _decima_parity_fixture(monkeypatch)
+    sched = make_sched()
+    bpol = sched.flat_batch_policy(deterministic=True)
+
+    calls = {"n": 0}
+
+    def bump():
+        calls["n"] += 1
+
+    def counting_bpol(rng, obs):
+        import jax.numpy as jnp
+
+        out = bpol(rng, obs)
+        # io_callback (not debug.callback): guaranteed to execute per
+        # scan iteration, ordered against the policy outputs
+        token = jax.experimental.io_callback(
+            bump, None, ordered=False
+        )
+        del token
+        return out
+
+    T = 40  # well under the fixture episode's decision count
+    keys = [jax.random.PRNGKey(3), jax.random.PRNGKey(5)]
+    states = jax.tree_util.tree_map(
+        lambda *a: jax.numpy.stack(a),
+        *[core.reset(params, bank, k) for k in keys],
+    )
+    ro = collect_flat_sync_batch(
+        params, bank, counting_bpol, jax.random.PRNGKey(1), T, states,
+        fulfill_bulk=True,
+    )
+    jax.block_until_ready(ro.reward)
+    per_lane = np.asarray(ro.valid).sum(axis=1)
+    assert per_lane.tolist() == [T, T], per_lane
+    # one batched evaluation per decision row — not ~2 per decision
+    assert calls["n"] == T, (calls["n"], T)
+
+
 @pytest.mark.parametrize(
     "dur_scale,moving_delay",
     [

@@ -59,4 +59,16 @@ def test_incremental_caches_match_golden():
             np.asarray(state.moving_count_to_stage),
             err_msg=f"moving_count diverged at step {step}",
         )
+        np.testing.assert_array_equal(
+            np.asarray(state.node_level),
+            np.asarray(state.node_level_golden),
+            err_msg=f"node_level diverged at step {step}",
+        )
+        # the observation view of the cache must equal the full
+        # [J,S,S] recomputation it replaced (masked to active jobs)
+        np.testing.assert_array_equal(
+            np.asarray(observe(params, state).node_level),
+            np.asarray(core.compute_node_levels(params, state)),
+            err_msg=f"observed node_level diverged at step {step}",
+        )
     assert bool(state.terminated), "episode did not terminate"

@@ -727,6 +727,101 @@ def test_decima_forward_matches_reference_torch_checkpoint():
     )
 
 
+def test_decima_job_compaction_parity_and_fallback():
+    """Round-8 compaction: `score` with a job_bucket K must produce the
+    same masked scores and greedy actions as the full-width net — via
+    the width-K compact path when <= K jobs are active, and via the
+    lax.cond full-width fallback when more are. Also checks the batched
+    form (leading [B] axis, scalar overflow predicate) and
+    `batch_policy` against per-lane greedy `policy`."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.env.observe import observe
+    from sparksched_tpu.schedulers.decima import (
+        DecimaScheduler,
+        sample_action,
+    )
+    from sparksched_tpu.workload import make_workload_bank
+
+    params = EnvParams(num_executors=6, max_jobs=12, job_arrival_rate=4e-5)
+    bank = make_workload_bank(6, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    full = DecimaScheduler(num_executors=6, seed=3)
+    comp = DecimaScheduler(num_executors=6, seed=3, job_bucket=4)
+
+    def check(obs):
+        f = full.features(obs)
+        sa, ea = full.net.apply(full.params, f)
+        sb, eb = comp.score(comp.params, f)
+        m = np.asarray(obs.node_mask)
+        jm = np.asarray(obs.job_mask)
+        np.testing.assert_allclose(
+            np.asarray(sb)[m], np.asarray(sa)[m], rtol=2e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(eb)[jm], np.asarray(ea)[jm], rtol=2e-5, atol=1e-6
+        )
+        a1, _ = sample_action(jax.random.PRNGKey(1), sa, ea, f, True)
+        a2, _ = sample_action(jax.random.PRNGKey(1), sb, eb, f, True)
+        assert int(a1.stage_idx) == int(a2.stage_idx)
+        assert int(a1.num_exec) == int(a2.num_exec)
+
+    st = core.reset(params, bank, jax.random.PRNGKey(0))
+    compact_hits, overflow_hits = 0, 0
+    obs_stack = []
+    for i in range(60):
+        obs = observe(params, st)
+        na = int(obs.num_active_jobs)
+        if na >= 1:
+            check(obs)
+            if na <= 4:
+                compact_hits += 1
+            else:
+                overflow_hits += 1
+            if len(obs_stack) < 4:
+                obs_stack.append(obs)
+        flat = np.flatnonzero(np.asarray(obs.schedulable).reshape(-1))
+        si = int(flat[i % max(1, flat.size)]) if flat.size else -1
+        st, _, _, _ = core.step(params, bank, st, si, 2)
+        if compact_hits >= 5 and overflow_hits >= 5 and len(obs_stack) == 4:
+            break
+    # both branches of the cond must actually have been exercised
+    assert compact_hits >= 3, compact_hits
+    assert overflow_hits >= 3, overflow_hits
+
+    # batched: one score call over a [B] stack, scalar predicate
+    batched = jax.tree_util.tree_map(
+        lambda *a: jnp.stack(a), *obs_stack
+    )
+    fb = jax.vmap(full.features)(batched)
+    sa, ea = full.net.apply(full.params, fb)
+    sb, eb = comp.score(comp.params, fb)
+    nm = np.asarray(fb.node_mask)
+    np.testing.assert_allclose(
+        np.asarray(sb)[nm], np.asarray(sa)[nm], rtol=2e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(eb)[np.asarray(fb.job_mask)],
+        np.asarray(ea)[np.asarray(fb.job_mask)],
+        rtol=2e-5, atol=1e-6,
+    )
+    # batch_policy (greedy) == per-lane policy (greedy)
+    si_b, ne_b, _ = comp.batch_policy(
+        jax.random.PRNGKey(5), batched, deterministic=True
+    )
+    for i, o in enumerate(obs_stack):
+        si, ne, _ = full.policy(
+            jax.random.PRNGKey(9), o, deterministic=True
+        )
+        assert int(si_b[i]) == int(si)
+        assert int(ne_b[i]) == int(ne)
+
+
 def test_decima_bf16_compute_close_to_f32():
     """compute_dtype='bfloat16' (MXU-native matmuls, f32 params) must
     track the f32 forward within bf16 tolerance and keep f32 outputs."""

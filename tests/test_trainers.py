@@ -467,6 +467,93 @@ def test_collect_flat_async_group_sequences_budget_and_resume():
     )
 
 
+def test_collect_flat_async_batch_group_sequences_budget_and_resume():
+    """Round-8 single-eval async collector: the same group-shared
+    sequence / budget / resume contract as the per-lane
+    `collect_flat_async` test above, on the batch-level
+    `collect_flat_async_batch` (one policy evaluation per decision
+    row, per-lane reset closures over seq_bases/lane_salts arrays)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.env.flat_loop import init_loop_state
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+    from sparksched_tpu.trainers.rollout import collect_flat_async_batch
+    from sparksched_tpu.workload import make_workload_bank
+
+    params = EnvParams(
+        num_executors=4, max_jobs=3, max_stages=20, max_levels=20,
+        moving_delay=500.0, warmup_delay=200.0,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+
+    def bpol(rng, obs):
+        def one(o):
+            return round_robin_policy(o, params.num_executors, True)
+
+        si, ne = jax.vmap(one)(obs)
+        return si, ne, {}
+
+    master = jax.random.PRNGKey(7)
+    seq_base = jax.random.fold_in(master, 0)
+    seq0 = jax.random.fold_in(seq_base, 0)
+    T = 120
+    lane_salts = jnp.asarray([1000, 1001], jnp.int32)
+    states = jax.vmap(
+        lambda salt: core.reset_pair(
+            params, bank, seq0, jax.random.fold_in(seq0, salt)
+        )
+    )(lane_salts)
+    ls0 = jax.vmap(init_loop_state)(states)
+    seq_bases = jnp.stack([seq_base, seq_base])
+    ro, ls = collect_flat_async_batch(
+        params, bank, bpol, jax.random.fold_in(master, 100), T, ls0,
+        1e9, seq_bases, lane_salts, jnp.asarray([1, 1], jnp.int32),
+    )
+    n_resets = [int(n) for n in np.asarray(ro.resets).sum(axis=1)]
+    assert min(n_resets) >= 2, n_resets
+    # lanes in the same group replay the same sequence at each ordinal
+    for ordinal in range(2):
+        tmpl = []
+        for lane in range(2):
+            idx = int(
+                np.flatnonzero(np.asarray(ro.resets)[lane])[ordinal]
+            ) + 1
+            assert idx < T
+            tmpl.append(np.asarray(ro.obs.job_template)[lane, idx])
+        np.testing.assert_array_equal(tmpl[0], tmpl[1])
+    np.testing.assert_array_equal(
+        np.asarray(ro.final_reset_count),
+        1 + np.asarray(n_resets),
+    )
+
+    # chunk 2 resumes from the returned LoopState and keeps collecting
+    ro2, _ = collect_flat_async_batch(
+        params, bank, bpol, jax.random.fold_in(master, 300), T, ls,
+        1e9, seq_bases, lane_salts, ro.final_reset_count,
+    )
+    assert int(np.asarray(ro2.valid).sum()) > 0
+
+    # sim-time budget freezes lanes near the boundary
+    budget = 2.0e6
+    ro3, _ = collect_flat_async_batch(
+        params, bank, bpol, jax.random.fold_in(master, 400), T, ls0,
+        jnp.float32(budget), seq_bases, lane_salts,
+        jnp.asarray([1, 1], jnp.int32),
+    )
+    total = float(np.asarray(ro3.wall_times)[0, -1])
+    assert total >= budget * 0.5, "budget never approached"
+    unbudgeted = float(np.asarray(ro.wall_times)[0, -1])
+    assert total < unbudgeted * 0.5, (
+        f"budget freeze ineffective: {total} vs {unbudgeted}"
+    )
+
+
 @pytest.mark.slow
 def test_stored_observation_roundtrip_is_exact():
     """An Observation rebuilt from a StoredObs must match the live one

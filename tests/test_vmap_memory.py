@@ -94,6 +94,34 @@ def test_vmapped_flat_loop_does_not_broadcast_bank(setup):
     assert not _batched_bank_shapes(txt, bank, B)
 
 
+def test_single_eval_batch_collect_does_not_broadcast_bank(setup):
+    """The round-8 single-eval collector drives decide/drain micro-steps
+    (lane-batched lax.switch branches + a batched drain while-loop);
+    every bank access must stay out of lane-dependent conditionals."""
+    import jax
+
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+    from sparksched_tpu.trainers.rollout import collect_flat_sync_batch
+
+    params, bank, states, B = setup
+
+    def bpol(rng, obs):
+        # batched heuristic stand-in: vmap the per-lane policy
+        def one(o):
+            si, ne = round_robin_policy(o, params.num_executors, True)
+            return si, ne
+        si, ne = jax.vmap(one)(obs)
+        return si, ne, {}
+
+    def f(s, r):
+        return collect_flat_sync_batch(
+            params, bank, bpol, r, 4, s, fulfill_bulk=True
+        )
+
+    txt = str(jax.make_jaxpr(f)(states, jax.random.PRNGKey(3)))
+    assert not _batched_bank_shapes(txt, bank, B)
+
+
 def test_vmapped_async_collect_does_not_broadcast_bank(setup):
     import jax
 
