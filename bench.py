@@ -119,6 +119,14 @@ TELEMETRY = os.environ.get("BENCH_TELEMETRY", "1") == "1"
 # advisor), even when a caller pins BENCH_NUM_ENVS=1024 explicitly
 CPU_FALLBACK = False
 
+# every row records whether the tree passes the static analyzer
+# (sparksched_tpu/analysis: jaxpr audit + AST lint + pytree contracts)
+# so perf rows from a dirty tree are self-identifying. Once per
+# process, CPU-pinned subprocess (it can never claim the accelerator
+# this bench holds); BENCH_ANALYSIS=0 stamps null, crash/timeout
+# stamps false — semantics live in analysis_clean_stamp.
+from sparksched_tpu.analysis import analysis_clean_stamp
+
 
 def _metric_suffix() -> str:
     if CPU_FALLBACK:
@@ -408,6 +416,7 @@ def main() -> None:
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
+        "analysis_clean": analysis_clean_stamp(),
         "config": {
             "num_envs": NUM_ENVS,
             "sub_batch": SUB_BATCH,

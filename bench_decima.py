@@ -48,6 +48,11 @@ TARGET = 50_000.0
 # turns it off, as in bench.py
 TELEMETRY = os.environ.get("BENCH_TELEMETRY", "1") == "1"
 
+# static-analyzer stamp on every row (once per process, CPU-pinned
+# subprocess; BENCH_ANALYSIS=0 stamps null, crash/timeout stamps false
+# — semantics live in sparksched_tpu/analysis:analysis_clean_stamp)
+from sparksched_tpu.analysis import analysis_clean_stamp  # noqa: E402
+
 
 def _flat_knobs() -> dict:
     """Flat-engine calibration knobs for the decima_flat rows (same
@@ -232,6 +237,7 @@ def bench_inference(
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
+        "analysis_clean": analysis_clean_stamp(),
         "config": cfg,
     }
     if TELEMETRY:
@@ -339,6 +345,7 @@ def bench_ppo(
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
+        "analysis_clean": analysis_clean_stamp(),
         "config": {
             "num_envs": num_envs,
             "rollout_steps": rollout_steps,

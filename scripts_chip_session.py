@@ -20,10 +20,15 @@ Stages (each guarded; a failure logs and moves on):
   9. labeled device trace: a short flat-engine chunk + Decima policy
      under jax.profiler with the obs.tracing annotations, written to
      artifacts/trace_chip for Perfetto (PERF.md "Reading a run")
+  10. static-analysis gate (sparksched_tpu/analysis): jaxpr audit +
+     AST lint + pytree contracts in a CPU-pinned subprocess — chip-safe
+     (never claims the device client), so it can run at any point
 
 Every bench row (stages 3/4/8) is stamped with the on-device telemetry
 summary — micro-step composition, straggler ratio, events/decision —
-by bench.py / bench_decima.py themselves (sparksched_tpu/obs).
+by bench.py / bench_decima.py themselves (sparksched_tpu/obs), and
+with `analysis_clean` (the stage-10 verdict, re-derived per bench
+process) so perf rows from a dirty tree are self-identifying.
 
 Usage: python scripts_chip_session.py [stage ...]   (default: 1 2 3 4)
 """
@@ -283,6 +288,42 @@ def stage_obs_trace():
           "env/micro_step, collect/scatter)", flush=True)
 
 
+def stage_analysis():
+    """Static-analysis gate (sparksched_tpu/analysis). Runs in a
+    CPU-pinned subprocess: tracing is backend-independent, and the gate
+    must never claim the device client a bench stage holds — so this
+    stage does NOT mark the client held and is safe anywhere in a
+    session (the watcher runs it once per lifetime at launch). Shares
+    the subprocess runner with the bench stamp
+    (sparksched_tpu/analysis:run_cli_subprocess) so the two gates'
+    verdicts cannot diverge."""
+    import json
+
+    from sparksched_tpu.analysis import run_cli_subprocess
+
+    r = run_cli_subprocess(quiet=False)
+    if r is None:
+        print("[analysis] TIMEOUT/SPAWN FAILURE; treating as dirty",
+              flush=True)
+        return
+    out = r.stdout.decode(errors="replace")
+    if r.returncode == 0:
+        print("[analysis] clean (rc=0)", flush=True)
+        return
+    # distinguish "rules fired" from "analyzer crashed": violations
+    # arrive as a JSON report on stdout; a crash leaves stdout empty
+    # (or non-JSON) and the traceback on stderr — print whichever is
+    # the actionable diagnostic so the watcher log never asserts a
+    # dirty tree with zero evidence
+    try:
+        json.loads(out)
+        print(f"[analysis] VIOLATIONS (rc={r.returncode})", flush=True)
+        print(out[-4000:], flush=True)
+    except ValueError:
+        print(f"[analysis] CRASHED (rc={r.returncode})", flush=True)
+        print(r.stderr.decode(errors="replace")[-4000:], flush=True)
+
+
 STAGES = {
     "1": ("sanity", stage_sanity),
     "2": ("burst sweep", stage_sweep),
@@ -293,6 +334,7 @@ STAGES = {
     "7": ("headline bench, sub-batch 1024", stage_bench_1024),
     "8": ("decima flat-engine benches", stage_bench_decima_flat),
     "9": ("labeled device trace", stage_obs_trace),
+    "10": ("static-analysis gate", stage_analysis),
 }
 
 
@@ -309,5 +351,7 @@ if __name__ == "__main__":
                 print("chip unavailable; aborting session", flush=True)
                 break
         finally:
-            if p != "7":
+            # 7 runs in a subprocess and 10 is CPU-subprocess-only:
+            # neither takes the in-process device client
+            if p not in ("7", "10"):
                 _mark_client_held()

@@ -37,6 +37,13 @@ pkill -f "scripts_plateau_train.py" 2>/dev/null
 # self-match wrapper shells in this harness, and \|-alternation in a
 # pkill ERE is a literal (round-4 advisor finding) — both made the old
 # pattern kill either nothing or the caller.
+# static-analysis gate once per watcher lifetime (PR 4): the bench rows
+# stamp analysis_clean per process anyway, but the watcher log should
+# say up front whether this tree is clean. CPU-pinned subprocess inside
+# stage 10 — never touches the tunnel, so it runs before any polling.
+timeout -k 30 1500 python scripts_chip_session.py 10 \
+  | tee /tmp/analysis_last.log
+
 CPU_TRAINER_PID=/tmp/cpu_trainer.pid
 
 cpu_trainer_alive() {

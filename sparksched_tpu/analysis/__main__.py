@@ -1,0 +1,60 @@
+"""`python -m sparksched_tpu.analysis` — run every static-analysis pass,
+print a JSON report, exit non-zero on any violation.
+
+Flags:
+  --passes lint,contracts,jaxpr   subset to run (default: all,
+                                  cheap-first)
+  --quiet                         violations-only JSON (no measured
+                                  counts) — the bench stamp subprocess
+                                  uses this
+Exit code 0 == analysis-clean tree.
+
+JAX_PLATFORMS defaults to cpu (tracing is backend-independent, and the
+audit must never claim an accelerator a bench session holds — PERF.md
+operational rules); an explicit JAX_PLATFORMS in the environment wins.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparksched_tpu.analysis",
+        description="TPU-hostility static analysis (jaxpr audit + AST "
+        "lint + pytree contracts)",
+    )
+    ap.add_argument(
+        "--passes", default="lint,contracts,jaxpr",
+        help="comma-separated subset of lint,contracts,jaxpr",
+    )
+    ap.add_argument(
+        "--quiet", action="store_true",
+        help="violations-only JSON (omit measured counts)",
+    )
+    args = ap.parse_args(argv)
+
+    # pin the backend BEFORE jax initializes (run_all imports it)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from . import run_all
+
+    passes = tuple(p for p in args.passes.split(",") if p)
+    report = run_all(passes)
+    if args.quiet:
+        report = {
+            "clean": report["clean"],
+            "violation_count": report["violation_count"],
+            "violations": report["violations"],
+        }
+    json.dump(report, sys.stdout, indent=1)
+    sys.stdout.write("\n")
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

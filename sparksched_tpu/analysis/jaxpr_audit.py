@@ -1,0 +1,470 @@
+"""Jaxpr auditor: trace the registered hot programs and check each
+rule-by-rule.
+
+The decision row's cost on op-count-bound backends tracks jaxpr
+equation counts (PERF.md round-4 census), host callbacks serialize the
+dispatch pipeline, f64/i64 leaves double memory traffic and poison
+compile keys, and a data-dependent while-loop reappearing in a
+pinned-loop-free program re-introduces the straggler tax the flat
+engine exists to remove. Each of those is a silent, gradual failure —
+this auditor makes them CI failures at the PR that introduces them.
+
+Rules (ids used in the JSON report and the fixture tests):
+
+- ``host-callback``: no callback primitives (`pure_callback`,
+  `io_callback`, `debug_callback`, ...) anywhere in a hot program,
+  outside the program's explicit `Budget.callback_allow` set (e.g. a
+  telemetry io_callback, should one ever be threaded on-device).
+- ``wide-dtype``: no f64/i64/u64/c128 avals anywhere — inputs,
+  outputs, or any intermediate equation.
+- ``loop-free``: programs pinned loop-free (`Budget.loop_free`)
+  contain no `while`/`scan` primitives at any nesting depth.
+- ``budget``: per-program equation/gather/scatter counts within the
+  declarative `BUDGETS` table below.
+
+Programs are traced with the AUDIT CONFIG shapes (10 executors,
+20-job/20-stage caps — the same shapes tests/test_jaxpr_budget.py
+pinned before the table moved here). Equation counts are
+shape-independent, so small shapes trace fast and the budgets hold at
+flagship scale; the Decima programs use the shipped agent architecture
+(config/decima_tpch.yaml: embed 16, gnn [32,16], policy [64,64]) with
+the compaction bucket scaled to the audit job cap so BOTH score
+branches (compact + full-width fallback) are in the audited program.
+Everything is traced via `jax.make_jaxpr`/`jax.eval_shape` over
+ShapeDtypeStructs — nothing executes on a device except tiny parameter
+init, so the audit is safe to run while a bench holds the accelerator
+(the CLI pins JAX_PLATFORMS=cpu regardless).
+
+Budgets were pinned under the default threefry PRNG (a key draw is
+~60 eqns under threefry vs 1 under rbg, so the impl is part of the
+measurement); the CLI never switches impls, and neither should a test
+importing this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Any, Callable
+
+from . import Violation
+
+WIDE_DTYPES = frozenset({"float64", "int64", "uint64", "complex128"})
+LOOP_PRIMS = frozenset({"while", "scan"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Budget:
+    """Per-program op budget. `eqn_*` bound total equations (including
+    nested sub-jaxprs), `gather_hi`/`scatter_hi` bound the gather- and
+    scatter-family primitive counts (they serialize on TPU, so growth
+    there hurts more than its eqn share suggests). `loop_free` pins the
+    program free of while/scan; `callback_allow` names callback
+    primitives the program may legitimately contain (empty everywhere
+    today — the telemetry counters are pure adds, not callbacks)."""
+
+    eqn_lo: int
+    eqn_hi: int
+    gather_hi: int
+    scatter_hi: int
+    loop_free: bool = False
+    callback_allow: frozenset = frozenset()
+
+
+# ---------------------------------------------------------------------------
+# THE budget table (single source of truth; tests/test_jaxpr_budget.py is
+# a thin wrapper over this).
+#
+# Re-pin procedure: run `python -m sparksched_tpu.analysis` — the report's
+# `passes.jaxpr.measured` block prints every program's measured eqn /
+# gather / scatter counts. A deliberate change that moves a count gets a
+# new cap of ~1.35x the measured value (gather/scatter: measured + max(2,
+# 35%)) IN THE SAME PR, with a bench row justifying the growth
+# (PERF.md "Static analysis"). Bands are deliberately loose: counts
+# drift a few percent across jax versions; a band breach means
+# structural growth, not noise.
+#
+# Pinned 2026-08 (jax 0.4.37, threefry, CPU trace) — measured eqns /
+# gathers / scatters: observe 78/0/0 (identical before and after the
+# implicit-dtype lint fixes, and to the tests/test_jaxpr_budget.py pin
+# this table absorbed), micro_step 4734/69/1, decide_micro_step
+# 2729/28/1, drain_to_decision 3374/45/1, decima_score 491/8/2,
+# decima_batch_policy 733/13/2, ppo_update 2856/43/3.
+# ---------------------------------------------------------------------------
+
+BUDGETS: dict[str, Budget] = {
+    # round 8 replaced observe's S-deep [J,S,S] fori_loop with the
+    # state-maintained node_level cache: the program must stay loop-free
+    # and within a small eqn band (migrated from test_jaxpr_budget.py)
+    "observe": Budget(
+        eqn_lo=20, eqn_hi=110, gather_hi=2, scatter_hi=2, loop_free=True,
+    ),
+    # one flat micro-step at the shipped bulk config (be=8,
+    # fulfill_bulk, cycles=1) — the engine's unit of work (migrated;
+    # the scan is the bulk-relaunch cascade, not a decision loop)
+    "micro_step": Budget(
+        eqn_lo=2000, eqn_hi=6400, gather_hi=95, scatter_hi=3,
+    ),
+    # the single-eval collectors' policy-bearing micro-step
+    "decide_micro_step": Budget(
+        eqn_lo=1000, eqn_hi=3700, gather_hi=40, scatter_hi=3,
+    ),
+    # the single-eval collectors' non-policy drain (while-loop by
+    # design: it runs until the lane is ready to DECIDE again)
+    "drain_to_decision": Budget(
+        eqn_lo=1500, eqn_hi=4600, gather_hi=65, scatter_hi=3,
+    ),
+    # Decima stage/exec scores over a [B]-stacked feature set, both
+    # compaction branches under the scalar cond (the scan is the
+    # level-wise GNN message pass)
+    "decima_score": Budget(
+        eqn_lo=150, eqn_hi=670, gather_hi=12, scatter_hi=4,
+    ),
+    # score + per-lane masked sampling over a lane stack
+    "decima_batch_policy": Budget(
+        eqn_lo=250, eqn_hi=990, gather_hi=18, scatter_hi=4,
+    ),
+    # one PPO update (epochs x minibatches scan, remat'd GNN recompute)
+    "ppo_update": Budget(
+        eqn_lo=1000, eqn_hi=3900, gather_hi=60, scatter_hi=5,
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def iter_eqns(jaxpr):
+    """Yield every equation including nested sub-jaxprs (cond/scan/while
+    branches, closed calls, custom_* wrappers)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in v if isinstance(v, (list, tuple)) else [v]:
+                if hasattr(sub, "jaxpr"):
+                    yield from iter_eqns(sub.jaxpr)
+                elif hasattr(sub, "eqns"):
+                    yield from iter_eqns(sub)
+
+
+def count_eqns(jaxpr) -> int:
+    return sum(1 for _ in iter_eqns(jaxpr))
+
+
+def primitive_counts(jaxpr) -> Counter:
+    return Counter(e.primitive.name for e in iter_eqns(jaxpr))
+
+
+def _gather_count(prims: Counter) -> int:
+    return sum(n for p, n in prims.items() if p == "gather")
+
+
+def _scatter_count(prims: Counter) -> int:
+    return sum(n for p, n in prims.items() if p.startswith("scatter"))
+
+
+def _iter_avals(jaxpr):
+    for v in list(jaxpr.invars) + list(jaxpr.outvars) + list(
+            jaxpr.constvars):
+        yield getattr(v, "aval", None)
+    for eqn in iter_eqns(jaxpr):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            yield getattr(v, "aval", None)
+
+
+def wide_dtype_avals(jaxpr) -> list[str]:
+    found = []
+    for aval in _iter_avals(jaxpr):
+        dt = getattr(aval, "dtype", None)
+        if dt is not None and str(dt) in WIDE_DTYPES:
+            found.append(f"{dt}{tuple(getattr(aval, 'shape', ()))}")
+    return found
+
+
+def audit_closed_jaxpr(name: str, closed, budget: Budget
+                       ) -> tuple[list[Violation], dict[str, Any]]:
+    """Apply every jaxpr rule to one traced program. Returns the
+    violations plus the measured counts (the re-pin surface)."""
+    jaxpr = closed.jaxpr
+    prims = primitive_counts(jaxpr)
+    n_eqns = sum(prims.values())
+    n_gather = _gather_count(prims)
+    n_scatter = _scatter_count(prims)
+    measured = {
+        "eqns": n_eqns,
+        "gathers": n_gather,
+        "scatters": n_scatter,
+        "loops": sorted(set(prims) & LOOP_PRIMS),
+    }
+    found: list[Violation] = []
+
+    callbacks = {
+        p for p in prims
+        if "callback" in p or p in ("outside_call", "host_callback")
+    }
+    bad_cb = callbacks - set(budget.callback_allow)
+    if bad_cb:
+        found.append(Violation(
+            "jaxpr", "host-callback", name,
+            f"callback primitives {sorted(bad_cb)} present "
+            f"({sum(prims[p] for p in bad_cb)} call sites) — host "
+            "callbacks serialize the dispatch pipeline; allowlist "
+            "explicitly in BUDGETS if deliberate",
+        ))
+
+    wide = wide_dtype_avals(jaxpr)
+    if wide:
+        found.append(Violation(
+            "jaxpr", "wide-dtype", name,
+            f"{len(wide)} f64/i64-family avals in the jaxpr (e.g. "
+            f"{wide[:3]}) — a single wide leaf doubles memory traffic "
+            "and recompiles every consumer",
+        ))
+
+    loops = set(prims) & LOOP_PRIMS
+    if budget.loop_free and loops:
+        found.append(Violation(
+            "jaxpr", "loop-free", name,
+            f"loop primitives {sorted(loops)} in a pinned-loop-free "
+            "program — the data-dependent loop this pin exists to keep "
+            "out came back",
+        ))
+
+    if not (budget.eqn_lo <= n_eqns <= budget.eqn_hi):
+        found.append(Violation(
+            "jaxpr", "budget", name,
+            f"eqn count {n_eqns} outside [{budget.eqn_lo}, "
+            f"{budget.eqn_hi}] — structural op growth (or a stale "
+            "budget); re-measure and re-pin in the same PR with a "
+            "bench row justifying it",
+        ))
+    if n_gather > budget.gather_hi:
+        found.append(Violation(
+            "jaxpr", "budget", name,
+            f"gather count {n_gather} > {budget.gather_hi}",
+        ))
+    if n_scatter > budget.scatter_hi:
+        found.append(Violation(
+            "jaxpr", "budget", name,
+            f"scatter count {n_scatter} > {budget.scatter_hi}",
+        ))
+    return found, measured
+
+
+# ---------------------------------------------------------------------------
+# audit config + program registry
+# ---------------------------------------------------------------------------
+
+_SETUP_CACHE: list = []
+
+
+def audit_setup():
+    """(params, bank, reset-state ShapeDtypeStruct pytree) under the
+    audit config — shared with the contracts pass so both agree on
+    shapes. The bank is real data (host numpy -> device constants);
+    the state is abstract."""
+    if _SETUP_CACHE:
+        return _SETUP_CACHE[0]
+    import jax
+
+    from ..config import EnvParams
+    from ..env import core
+    from ..workload import make_workload_bank
+
+    params = EnvParams(
+        num_executors=10, max_jobs=20, max_stages=20, max_levels=20
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    state = jax.eval_shape(lambda k: core.reset(params, bank, k), key)
+    _SETUP_CACHE.append((params, bank, state))
+    return _SETUP_CACHE[0]
+
+
+def _shipped_agent_kwargs() -> dict[str, Any]:
+    """The shipped Decima architecture (config/decima_tpch.yaml agent
+    section). Hard-coded rather than YAML-loaded so the audit is
+    self-contained; drift is caught by the budget band moving."""
+    return {
+        "embed_dim": 16,
+        "gnn_mlp_kwargs": {
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        "policy_mlp_kwargs": {"hid_dims": [64, 64], "act_cls": "Tanh"},
+    }
+
+
+def _batched(tree, b: int):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct((b,) + tuple(l.shape), l.dtype),
+        tree,
+    )
+
+
+def build_programs(names: tuple[str, ...] | None = None
+                   ) -> dict[str, Any]:
+    """Trace the registered hot programs; returns name -> ClosedJaxpr.
+    Order is cheap-first. `names` restricts the registry (the thin
+    test wrappers trace only what they pin)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..env.flat_loop import (
+        decide_micro_step,
+        drain_to_decision,
+        init_loop_state,
+        micro_step,
+    )
+    from ..env.observe import observe
+    from ..schedulers.decima import DecimaScheduler
+    from ..schedulers.heuristics import round_robin_policy
+
+    params, bank, state = audit_setup()
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    ls = jax.eval_shape(init_loop_state, state)
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    want = set(names) if names is not None else None
+    programs: dict[str, Any] = {}
+
+    def trace(name: str, fn: Callable, *args) -> None:
+        if want is None or name in want:
+            programs[name] = jax.make_jaxpr(fn)(*args)
+
+    trace("observe", lambda s: observe(params, s), state)
+    # the shipped bulk config: be=8, fulfill_bulk on, one cycle
+    # (compute_levels=False as in bench.py's driving loop)
+    trace(
+        "micro_step",
+        lambda l, r: micro_step(
+            params, bank, pol, l, r, True, False, True, 8, True, 1
+        ),
+        ls, key,
+    )
+    trace(
+        "decide_micro_step",
+        lambda l, si, ne, r: decide_micro_step(
+            params, bank, l, si, ne, r, True, True
+        ),
+        ls, i32, i32, key,
+    )
+    trace(
+        "drain_to_decision",
+        lambda l, r: drain_to_decision(
+            params, bank, l, r, True, True, 8, 1
+        ),
+        ls, key,
+    )
+
+    if want is None or want & {"decima_score", "decima_batch_policy"}:
+        # compaction bucket scaled to the audit job cap (flagship K=32
+        # over a 200-job cap -> K=8 over 20) so the cond's BOTH
+        # branches are in the audited program
+        sched = DecimaScheduler(
+            num_executors=params.num_executors, job_bucket=8,
+            **_shipped_agent_kwargs(),
+        )
+        obs_b = jax.eval_shape(
+            lambda s: jax.vmap(lambda x: observe(params, x))(s),
+            _batched(state, 4),
+        )
+        feats_b = jax.eval_shape(
+            lambda o: jax.vmap(sched.features)(o), obs_b
+        )
+        trace(
+            "decima_score",
+            lambda f: sched.score(sched.params, f), feats_b,
+        )
+        trace(
+            "decima_batch_policy",
+            lambda r, o: sched.batch_policy(r, o), key, obs_b,
+        )
+
+    if want is None or "ppo_update" in want:
+        programs["ppo_update"] = _trace_ppo_update()
+    return programs
+
+
+def _trace_ppo_update():
+    """Trace one PPO update at a tiny audit scale (2 lanes, 16 decision
+    steps). The rollout is abstract (`eval_shape` over `_collect`), so
+    nothing episode-sized executes; `make_jaxpr(_update)` then traces
+    the real epochs x minibatches scan with the remat'd GNN recompute."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..trainers.ppo import PPO
+
+    agent_cfg = {"agent_cls": "DecimaScheduler"} | _shipped_agent_kwargs()
+    env_cfg = {
+        "num_executors": 5,
+        "job_arrival_cap": 3,
+        "moving_delay": 2000.0,
+        "mean_time_limit": 2.0e7,
+        "job_arrival_rate": 4.0e-5,
+        "warmup_delay": 1000.0,
+    }
+    train_cfg = {
+        "trainer_cls": "PPO",
+        "num_iterations": 1,
+        "num_sequences": 1,
+        "num_rollouts": 2,
+        "seed": 0,
+        "use_tensorboard": False,
+        "num_epochs": 1,
+        "num_batches": 2,
+        "beta_discount": 5.0e-3,
+        "opt_kwargs": {"lr": 3.0e-4},
+        "max_grad_norm": 0.5,
+        "rollout_steps": 16,
+        "checkpointing_freq": 10**9,
+    }
+    trainer = PPO(agent_cfg, env_cfg, train_cfg)
+    state = jax.eval_shape(trainer.init_state)
+    it = jax.ShapeDtypeStruct((), jnp.int32)
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    ro, _, _ = jax.eval_shape(
+        lambda p, i, r: trainer._collect(p, i, r, None),
+        state.params, it, key,
+    )
+    return jax.make_jaxpr(trainer._update)(state, ro)
+
+
+def audit_all(names: tuple[str, ...] | None = None
+              ) -> tuple[list[Violation], dict[str, Any]]:
+    """Trace + audit every registered program (or the `names` subset).
+    Returns (violations, measured-counts dict for the report)."""
+    if names is not None:
+        unknown = set(names) - set(BUDGETS)
+        if unknown:
+            raise ValueError(
+                f"unknown program name(s) {sorted(unknown)} — the "
+                "registry is the BUDGETS table's key set"
+            )
+    programs = build_programs(names)
+    found: list[Violation] = []
+    measured: dict[str, Any] = {}
+    for name, closed in programs.items():
+        if name not in BUDGETS:
+            found.append(Violation(
+                "jaxpr", "budget", name,
+                "program has no entry in the BUDGETS table",
+            ))
+            continue
+        vs, m = audit_closed_jaxpr(name, closed, BUDGETS[name])
+        found.extend(vs)
+        measured[name] = m
+    return found, measured

@@ -47,7 +47,7 @@ _i32 = jnp.int32
 
 
 def _onehot(n: int, e: jnp.ndarray) -> jnp.ndarray:
-    return jnp.arange(n) == e
+    return jnp.arange(n, dtype=_i32) == e
 
 
 def _onehot2(j_cap: int, s_cap: int, j: jnp.ndarray, s: jnp.ndarray
@@ -68,7 +68,7 @@ def find_schedulable(
     """bool[J,S]. A stage is schedulable iff its job passes the saturation
     filter (source job exempt), it is ready (unsaturated with all parents
     saturated), and it was not selected this round."""
-    j_idx = jnp.arange(params.max_jobs)
+    j_idx = jnp.arange(params.max_jobs, dtype=_i32)
     job_ok = state.job_active & (
         (j_idx == source_job_id)
         | (state.job_supply < params.num_executors)
@@ -186,7 +186,7 @@ def _find_backup_stage(params: EnvParams, state: EnvState, e: jnp.ndarray,
     sched = find_schedulable(params, state, eff_src)
     j_cap, s_cap = sched.shape
     flat = sched.reshape(-1)
-    pos = jnp.arange(j_cap * s_cap)
+    pos = jnp.arange(j_cap * s_cap, dtype=_i32)
     job_of = pos // s_cap
 
     local = flat & (job_of == own)
@@ -509,7 +509,7 @@ def _bulk_fulfill(
     """
     n = state.exec_job.shape[0]
     j_cap, s_cap = state.stage_remaining.shape
-    pos = jnp.arange(n)
+    pos = jnp.arange(n, dtype=_i32)
 
     e = exec_order
     slot = slot_order
@@ -591,7 +591,9 @@ def _bulk_fulfill(
     n_inc = inc.sum()
 
     fin_k = state.wall_time + durs
-    arr_k = jnp.full((n,), state.wall_time + params.moving_delay)
+    arr_k = jnp.full(
+        (n,), state.wall_time + params.moving_delay, jnp.float32
+    )
 
     # ---- per-executor scatters (each candidate's executor is unique)
     sel = prefix[:, None] & (e[:, None] == pos[None, :])  # [cand, exec]
@@ -628,11 +630,11 @@ def _bulk_fulfill(
 
     # ---- per-stage counters (destination stages)
     oh_j = (
-        (dj[:, None] == jnp.arange(j_cap)[None, :])
+        (dj[:, None] == jnp.arange(j_cap, dtype=_i32)[None, :])
         & prefix[:, None]
         & ~common_dst[:, None]
     )  # [cand, J]
-    oh_s = ds0[:, None] == jnp.arange(s_cap)[None, :]
+    oh_s = ds0[:, None] == jnp.arange(s_cap, dtype=_i32)[None, :]
     m3 = oh_j[:, :, None] & oh_s[:, None, :]  # [cand, J, S]
     cnt_start = (m3 & start[:, None, None]).sum(0).astype(_i32)
     cnt_send = (m3 & send[:, None, None]).sum(0).astype(_i32)
@@ -735,7 +737,9 @@ def _fulfill_from_source(
     idle = state.source_pool_mask() & ~state.exec_executing
     num_idle = jnp.where(active, idle.sum(), 0)
 
-    exec_order = _rank_order(jnp.where(idle, jnp.arange(n), BIG_SEQ))
+    exec_order = _rank_order(
+        jnp.where(idle, jnp.arange(n, dtype=_i32), BIG_SEQ)
+    )
     match = (
         state.cm_valid
         & (state.cm_src_job == state.source_job)
@@ -999,7 +1003,7 @@ def _rank_order(key: jnp.ndarray) -> jnp.ndarray:
     the engine's N-sized keys a batched sort kernel costs far more than
     these few elementwise reduces."""
     n = key.shape[0]
-    pos = jnp.arange(n)
+    pos = jnp.arange(n, dtype=_i32)
     lt = (key[None, :] < key[:, None]) | (
         (key[None, :] == key[:, None]) & (pos[None, :] < pos[:, None])
     )
@@ -1060,7 +1064,7 @@ def _bulk_relaunch(
     """
     n = state.exec_finish_time.shape[0]
     j_cap, s_cap = state.stage_remaining.shape
-    pos = jnp.arange(n)
+    pos = jnp.arange(n, dtype=_i32)
 
     # earliest non-finish competitor, lexicographic (time, seq)
     t_job = jnp.where(state.job_arrived, INF, state.job_arrival_time)
@@ -1181,8 +1185,8 @@ def _bulk_relaunch(
     adj_row = state.adj[jc, sc]  # [N, S] children of each rep's stage
 
     # scatter into [J,S] through rep-masked payload reduces
-    oh_j = je[:, None] == jnp.arange(j_cap)[None, :]
-    oh_s = se[:, None] == jnp.arange(s_cap)[None, :]
+    oh_j = je[:, None] == jnp.arange(j_cap, dtype=_i32)[None, :]
+    oh_s = se[:, None] == jnp.arange(s_cap, dtype=_i32)[None, :]
     m = oh_j[:, :, None] & oh_s[:, None, :] & rep[:, None, None]
     cnt = (m * cnt_i[:, None, None]).sum(0)
     aff = cnt > 0
@@ -1240,7 +1244,7 @@ def _bulk_ready(
     """
     n = state.exec_job.shape[0]
     j_cap, s_cap = state.stage_remaining.shape
-    pos = jnp.arange(n)
+    pos = jnp.arange(n, dtype=_i32)
 
     # earliest non-ready competitor, lexicographic (time, seq)
     t_job = jnp.where(state.job_arrived, INF, state.job_arrival_time)
@@ -1320,7 +1324,7 @@ def _bulk_ready(
     # must stop there
     gen = jnp.where(start0, fin_k, INF)
     gen_before = jnp.concatenate(
-        [jnp.full((1,), INF), lax.cummin(gen)[:-1]]
+        [jnp.full((1,), INF, jnp.float32), lax.cummin(gen)[:-1]]
     )
     # an arrival that joins the LIVE source pool can raise
     # num_committable above 0; the sequential per-event tail reacts
@@ -1373,7 +1377,7 @@ def _bulk_ready(
     arrived = prefix
     exec_moving = exflag(state.exec_moving, arrived, False)
     exec_arrive_time = exset(
-        state.exec_arrive_time, arrived, jnp.full((n,), INF)
+        state.exec_arrive_time, arrived, jnp.full((n,), INF, jnp.float32)
     )
     exec_at_common = exflag(state.exec_at_common, arrived, False)
     exec_job = exset(state.exec_job, arrived, dj)
@@ -1389,8 +1393,9 @@ def _bulk_ready(
     exec_finish_seq = exset(state.exec_finish_seq, start, seq_k)
 
     # ---- per-stage counters (every prefix arrival was counted moving)
-    oh_j = (dj[:, None] == jnp.arange(j_cap)[None, :]) & prefix[:, None]
-    oh_s = ds0[:, None] == jnp.arange(s_cap)[None, :]
+    oh_j = (dj[:, None] == jnp.arange(j_cap, dtype=_i32)[None, :]) \
+        & prefix[:, None]
+    oh_s = ds0[:, None] == jnp.arange(s_cap, dtype=_i32)[None, :]
     m3 = oh_j[:, :, None] & oh_s[:, None, :]
     cnt_arr = m3.sum(0).astype(_i32)
     cnt_start = (m3 & start[:, None, None]).sum(0).astype(_i32)
@@ -1660,7 +1665,7 @@ def reset_from_sequence(
     state = empty_state(params, rng)
     s_cap = params.max_stages
     ns = jnp.where(mask, bank.num_stages[templates], 0)
-    exists = (jnp.arange(s_cap)[None, :] < ns[:, None])
+    exists = (jnp.arange(s_cap, dtype=_i32)[None, :] < ns[:, None])
     ntasks = jnp.where(exists, bank.num_tasks[templates], 0)
     rough = jnp.where(exists, bank.rough_duration[templates], 0.0)
     adj = bank.adj[templates] & exists[:, :, None] & exists[:, None, :]

@@ -283,7 +283,7 @@ def _apply_decision(
         idle = st.source_pool_mask() & ~st.exec_executing
         num_idle = idle.sum().astype(_i32)
         exec_order = _rank_order(
-            jnp.where(idle, jnp.arange(n), BIG_SEQ)
+            jnp.where(idle, jnp.arange(n, dtype=_i32), BIG_SEQ)
         )
         match = (
             st.cm_valid

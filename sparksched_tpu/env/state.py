@@ -263,7 +263,7 @@ class EnvState(struct.PyTreeNode):
 
     @property
     def all_jobs_complete(self) -> jnp.ndarray:
-        j = jnp.arange(self.job_arrived.shape[0])
+        j = jnp.arange(self.job_arrived.shape[0], dtype=jnp.int32)
         return jnp.where(j < self.num_jobs, self.job_completed, True).all()
 
     # --- pools ---
@@ -320,10 +320,10 @@ def empty_state(params: EnvParams, rng: jax.Array) -> EnvState:
         terminated=jnp.bool_(False),
         truncated=jnp.bool_(False),
         job_template=jnp.zeros(j, i32),
-        job_arrival_time=jnp.full(j, INF),
+        job_arrival_time=jnp.full(j, INF, f32),
         job_arrival_seq=jnp.zeros(j, i32),
         job_arrived=jnp.zeros(j, bool),
-        job_t_completed=jnp.full(j, INF),
+        job_t_completed=jnp.full(j, INF, f32),
         job_num_stages=jnp.zeros(j, i32),
         job_saturated_stages=jnp.zeros(j, i32),
         job_supply=jnp.zeros(j, i32),
@@ -343,12 +343,12 @@ def empty_state(params: EnvParams, rng: jax.Array) -> EnvState:
         exec_moving=jnp.zeros(n, bool),
         exec_dst_job=jnp.full(n, -1, i32),
         exec_dst_stage=jnp.full(n, -1, i32),
-        exec_arrive_time=jnp.full(n, INF),
+        exec_arrive_time=jnp.full(n, INF, f32),
         exec_arrive_seq=jnp.zeros(n, i32),
         exec_executing=jnp.zeros(n, bool),
         exec_task_valid=jnp.zeros(n, bool),
         exec_task_stage=jnp.full(n, -1, i32),
-        exec_finish_time=jnp.full(n, INF),
+        exec_finish_time=jnp.full(n, INF, f32),
         exec_finish_seq=jnp.zeros(n, i32),
         stage_sat=jnp.ones((j, s), bool),
         unsat_parent_count=jnp.zeros((j, s), i32),

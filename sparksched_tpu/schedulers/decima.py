@@ -69,7 +69,7 @@ def build_features(
     commit-cap/N, ±1 source-job flag, exec-supply/N, tasks/200, work/1e5."""
     n = num_executors
     j_cap = obs.job_mask.shape[0]
-    j_idx = jnp.arange(j_cap)
+    j_idx = jnp.arange(j_cap, dtype=_i32)
 
     supplies = obs.exec_supplies
     committable = obs.num_committable
@@ -94,7 +94,9 @@ def build_features(
     ).astype(jnp.float32)
     x = jnp.where(obs.node_mask[..., None], x, 0.0)
 
-    exec_mask = (jnp.arange(n)[None, :] < caps[:, None]) & obs.job_mask[
+    exec_mask = (
+        jnp.arange(n, dtype=_i32)[None, :] < caps[:, None]
+    ) & obs.job_mask[
         :, None
     ]
     adj = obs.adj & obs.node_mask[:, :, None] & obs.node_mask[:, None, :]
@@ -136,7 +138,9 @@ def compact_features(
     j_cap = f.job_mask.shape[0]
     # active ids are the smallest entries of this ascending sort, so
     # rows 0..num_active-1 are exactly the active jobs in id order
-    ids = jnp.sort(jnp.where(f.job_mask, jnp.arange(j_cap), j_cap))[:k]
+    ids = jnp.sort(
+        jnp.where(f.job_mask, jnp.arange(j_cap, dtype=_i32), j_cap)
+    )[:k]
     valid = ids < j_cap
     idx = jnp.minimum(ids, j_cap - 1)  # clamp gathers for empty rows
     vm = valid[:, None]
@@ -297,7 +301,7 @@ class DecimaNet(nn.Module):
             return h_node, None
 
         nl = min(self.num_levels, s_cap) if self.num_levels else s_cap
-        levels = jnp.arange(nl - 1, -1, -1)
+        levels = jnp.arange(nl - 1, -1, -1, dtype=_i32)
         h_node, _ = nn.scan(
             level_step,
             variable_broadcast="params",
@@ -346,7 +350,7 @@ class DecimaNet(nn.Module):
             x, first[..., None, None], axis=-2
         )[..., 0, :NUM_DAG_FEATURES]
         n = self.num_executors
-        k_frac = (jnp.arange(n) / n).astype(x.dtype)
+        k_frac = (jnp.arange(n, dtype=_i32) / n).astype(x.dtype)
         per_job = jnp.concatenate([x_dag, h_dag], axis=-1)
         exec_in = jnp.concatenate(
             [
@@ -697,7 +701,7 @@ def _hashable(obj):
 def _dummy_features(num_executors: int) -> DecimaFeatures:
     j, s = 2, 3
     return DecimaFeatures(
-        x=jnp.zeros((j, s, NUM_NODE_FEATURES)),
+        x=jnp.zeros((j, s, NUM_NODE_FEATURES), jnp.float32),
         node_mask=jnp.ones((j, s), bool),
         job_mask=jnp.ones((j,), bool),
         stage_mask=jnp.ones((j, s), bool),

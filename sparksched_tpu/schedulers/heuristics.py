@@ -60,7 +60,7 @@ def round_robin_policy(
 
     # branch 2: jobs in arrival order == job-id order (job ids are assigned
     # in arrival order both here and in the reference)
-    j_idx = jnp.arange(j_cap)
+    j_idx = jnp.arange(j_cap, dtype=jnp.int32)
     supplies = obs.exec_supplies
     want = obs.job_mask & has & (supplies < cap) & (j_idx != src)
     any_want = want.any()

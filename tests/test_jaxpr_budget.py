@@ -1,128 +1,30 @@
-"""Hot-path op-count regression guards (round-8 satellite).
+"""Hot-path op-count regression guards — thin wrapper over the static
+analyzer (PR 4).
 
-The decision row's cost on op-count-bound backends tracks jaxpr equation
-counts (PERF.md round-4 census), so silent op growth in the hot programs
-should fail CI instead of surfacing rounds later as a bench regression.
-Pinned here:
-
-- `observe` with levels: round 8 replaced the S-deep [J,S,S]
-  topological-generation fori_loop (the documented most expensive part
-  of an observation) with a read of the state-maintained `node_level`
-  cache — the program must stay loop-free (no while/scan primitives at
-  all) and within a small eqn budget;
-- one flat `micro_step` at the shipped bulk config — the engine's unit
-  of work.
-
-Bands are deliberately loose (~+35% over the measured value at pinning
-time): counts drift a few percent across jax versions; a band breach
-means structural growth, not noise. If a deliberate change moves a
-count, re-measure and re-pin in the same PR.
+The eqn budgets this file used to pin in-line (round-8 satellite) now
+live in ONE declarative table, `sparksched_tpu/analysis/jaxpr_audit.py:
+BUDGETS`, together with the gather/scatter caps, the loop-free pins and
+the host-callback/wide-dtype rules; the table's header comment documents
+the measured values and the re-pin procedure. This test keeps the
+original guard's granularity — the two round-8 programs (`observe`,
+`micro_step`) audited on their own — so a budget breach in either still
+fails under the familiar test name; `tests/test_static_analysis.py`
+audits the full registry.
 """
 
 from __future__ import annotations
 
-import pytest
 
+def test_observe_and_micro_step_within_budget():
+    from sparksched_tpu.analysis import jaxpr_audit
 
-def _count_eqns(jaxpr) -> int:
-    """Total equations including nested sub-jaxprs (cond/scan/while
-    branches, closed calls)."""
-    n = 0
-    for eqn in jaxpr.eqns:
-        n += 1
-        for v in eqn.params.values():
-            for sub in v if isinstance(v, (list, tuple)) else [v]:
-                if hasattr(sub, "jaxpr"):
-                    n += _count_eqns(sub.jaxpr)
-                elif hasattr(sub, "eqns"):
-                    n += _count_eqns(sub)
-    return n
-
-
-def _primitives(jaxpr, acc=None) -> set:
-    if acc is None:
-        acc = set()
-    for eqn in jaxpr.eqns:
-        acc.add(eqn.primitive.name)
-        for v in eqn.params.values():
-            for sub in v if isinstance(v, (list, tuple)) else [v]:
-                if hasattr(sub, "jaxpr"):
-                    _primitives(sub.jaxpr, acc)
-                elif hasattr(sub, "eqns"):
-                    _primitives(sub, acc)
-    return acc
-
-
-@pytest.fixture(scope="module")
-def setup():
-    import jax
-
-    from sparksched_tpu.config import EnvParams
-    from sparksched_tpu.env import core
-    from sparksched_tpu.workload import make_workload_bank
-
-    params = EnvParams(
-        num_executors=10, max_jobs=20, max_stages=20, max_levels=20
+    violations, measured = jaxpr_audit.audit_all(
+        names=("observe", "micro_step")
     )
-    bank = make_workload_bank(params.num_executors, params.max_stages)
-    params = params.replace(
-        max_stages=bank.max_stages, max_levels=bank.max_stages
-    )
-    state = core.reset(params, bank, jax.random.PRNGKey(0))
-    return params, bank, state
-
-
-# measured at pinning time (2026-08, jax in this image): 78
-OBSERVE_EQN_CAP = 110
-
-
-def test_observe_jaxpr_is_loop_free_and_bounded(setup):
-    import jax
-
-    from sparksched_tpu.env.observe import observe
-
-    params, _, state = setup
-    jx = jax.make_jaxpr(lambda s: observe(params, s))(state)
-    n = _count_eqns(jx.jaxpr)
-    assert 20 <= n <= OBSERVE_EQN_CAP, (
-        f"observe eqn count {n} outside [20, {OBSERVE_EQN_CAP}] — the "
-        "levels fori_loop (or comparable op growth) came back; observe "
-        "must read the incremental node_level cache"
-    )
-    loops = _primitives(jx.jaxpr) & {"while", "scan"}
-    assert not loops, (
-        f"observe contains loop primitives {loops}; with the "
-        "node_level cache the observation must be loop-free"
-    )
-
-
-# measured at pinning time: 4734 (be=8, fulfill_bulk, cycles=1; the
-# round-4 census measured 4532 before the node_level row maintenance)
-MICRO_STEP_EQN_CAP = 6200
-
-
-def test_micro_step_jaxpr_budget(setup):
-    import jax
-
-    from sparksched_tpu.env.flat_loop import init_loop_state, micro_step
-
-    params, bank, state = setup
-
-    from sparksched_tpu.schedulers.heuristics import round_robin_policy
-
-    def pol(rng, obs):
-        si, ne = round_robin_policy(obs, params.num_executors, True)
-        return si, ne, {}
-
-    ls = init_loop_state(state)
-    jx = jax.make_jaxpr(
-        lambda l, r: micro_step(
-            params, bank, pol, l, r, True, False, True, 8, True, 1
-        )
-    )(ls, jax.random.PRNGKey(1))
-    n = _count_eqns(jx.jaxpr)
-    assert 2000 <= n <= MICRO_STEP_EQN_CAP, (
-        f"micro_step eqn count {n} outside [2000, {MICRO_STEP_EQN_CAP}]"
-        " — hot-path op growth; re-measure and re-pin only with a bench"
-        " row justifying it"
-    )
+    assert set(measured) == {"observe", "micro_step"}
+    assert not violations, "\n".join(map(str, violations))
+    # the audit actually traced real programs (belt and braces against
+    # a registry refactor silently dropping a name)
+    assert measured["observe"]["eqns"] >= 20
+    assert measured["observe"]["loops"] == []
+    assert measured["micro_step"]["eqns"] >= 2000

@@ -1,19 +1,16 @@
 """Observability subsystem (sparksched_tpu/obs): runlog JSONL schema,
-telemetry summaries, profiler trace hygiene, the TensorBoard fallback,
-and the no-bare-print lint tier."""
+telemetry summaries, profiler trace hygiene, and the TensorBoard
+fallback. (The no-bare-print lint that used to live here is now the
+analyzer's `bare-print` rule — sparksched_tpu/analysis/lint.py, run by
+tests/test_static_analysis.py.)"""
 
 from __future__ import annotations
 
-import io
 import json
-import pathlib
 import sys
-import tokenize
 
 import numpy as np
 import pytest
-
-PKG = pathlib.Path(__file__).resolve().parent.parent / "sparksched_tpu"
 
 
 def _tiny_cfg(tmp_path, **trainer_overrides):
@@ -223,46 +220,3 @@ def test_training_iteration_writes_runlog(tmp_path):
         assert key in sc, f"scalars record missing {key}"
 
 
-# ---------------------------------------------------------------------------
-# lint tier (satellite): no bare print( in sparksched_tpu/ outside
-# renderer.py — host-loop output goes through obs.runlog (emit / the
-# JSONL sink) so it stays machine-readable and console-consistent
-# ---------------------------------------------------------------------------
-
-
-def test_no_bare_print_calls_outside_renderer():
-    offenders = []
-    for path in sorted(PKG.rglob("*.py")):
-        if path.name == "renderer.py":
-            continue
-        src = path.read_text()
-        toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
-        for i, tok in enumerate(toks):
-            if tok.type != tokenize.NAME or tok.string != "print":
-                continue
-            # a call: next significant token is "("
-            nxt = next(
-                (t for t in toks[i + 1:]
-                 if t.type not in (tokenize.NL, tokenize.NEWLINE,
-                                   tokenize.COMMENT)),
-                None,
-            )
-            if nxt is None or nxt.string != "(":
-                continue
-            # not a method/attribute (e.g. file.print) — check prev
-            prev = next(
-                (t for t in reversed(toks[:i])
-                 if t.type not in (tokenize.NL, tokenize.NEWLINE,
-                                   tokenize.COMMENT, tokenize.INDENT,
-                                   tokenize.DEDENT)),
-                None,
-            )
-            if prev is not None and prev.string in (".", "def"):
-                continue
-            offenders.append(
-                f"{path.relative_to(PKG)}:{tok.start[0]}"
-            )
-    assert not offenders, (
-        "bare print( calls in sparksched_tpu/ (use obs.runlog.emit or "
-        f"the JSONL runlog instead): {offenders}"
-    )

@@ -1,0 +1,535 @@
+"""Static-analysis subsystem (sparksched_tpu/analysis): the tier-1
+clean-tree run, a seeded-violation fixture per rule (every rule has a
+pinned true positive — a rule that cannot fire is worse than no rule),
+and the contract checker's runtime-assert mode around real episodes on
+both engines."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# the analyzer is the CI gate: the shipped tree must be clean
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_tree_is_analysis_clean():
+    from sparksched_tpu.analysis import run_all
+
+    report = run_all(("lint", "contracts", "jaxpr"))
+    assert report["clean"], "\n".join(
+        f"[{v['passname']}/{v['rule']}] {v['where']}: {v['detail']}"
+        for v in report["violations"]
+    )
+    # >= 8 rules across three passes is the subsystem's acceptance bar;
+    # the registry traced every hot program
+    assert set(report["passes"]["jaxpr"]["measured"]) == {
+        "observe", "micro_step", "decide_micro_step",
+        "drain_to_decision", "decima_score", "decima_batch_policy",
+        "ppo_update",
+    }
+
+
+def test_cli_json_and_exit_code():
+    """The CLI contract: JSON on stdout, exit 0 on a clean tree. Runs
+    the cheap passes only — the full jaxpr audit already runs
+    in-process above, and a subprocess re-trace would double tier-1's
+    trace bill for no new signal."""
+    r = subprocess.run(
+        [sys.executable, "-m", "sparksched_tpu.analysis",
+         "--passes", "lint,contracts", "--quiet"],
+        capture_output=True, timeout=600,
+        cwd=pathlib.Path(__file__).resolve().parent.parent,
+    )
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+    report = json.loads(r.stdout)
+    assert report["clean"] is True and report["violations"] == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr rules: seeded violations
+# ---------------------------------------------------------------------------
+
+
+def _audit_one(fn, *args, **budget_kw):
+    import jax
+
+    from sparksched_tpu.analysis import jaxpr_audit
+
+    budget = jaxpr_audit.Budget(**({
+        "eqn_lo": 0, "eqn_hi": 10**6,
+        "gather_hi": 10**6, "scatter_hi": 10**6,
+    } | budget_kw))
+    jx = jax.make_jaxpr(fn)(*args)
+    return jaxpr_audit.audit_closed_jaxpr("fixture", jx, budget)
+
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+def test_rule_host_callback_fires_and_allowlist_clears():
+    import jax
+    import jax.numpy as jnp
+
+    def bad(x):
+        jax.debug.print("x={x}", x=x)  # lowers to a callback primitive
+        return x + 1
+
+    vs, measured = _audit_one(bad, jnp.float32(1.0))
+    assert "host-callback" in _rules(vs)
+    # the explicit allowlist (the telemetry-io_callback escape hatch)
+    # clears exactly that rule
+    vs2, _ = _audit_one(
+        bad, jnp.float32(1.0),
+        callback_allow=frozenset({"debug_callback"}),
+    )
+    assert "host-callback" not in _rules(vs2)
+
+
+def test_rule_wide_dtype_fires():
+    import jax
+    import jax.numpy as jnp
+
+    with jax.experimental.enable_x64():
+        vs, _ = _audit_one(
+            lambda x: x.astype(jnp.float64) * 2.0, jnp.float32(1.0)
+        )
+    assert "wide-dtype" in _rules(vs)
+
+
+def test_rule_loop_free_fires():
+    import jax.numpy as jnp
+    from jax import lax
+
+    def scanny(x):
+        return lax.scan(lambda c, _: (c + x, None), 0.0, None, length=4)[0]
+
+    vs, _ = _audit_one(scanny, jnp.float32(1.0), loop_free=True)
+    assert "loop-free" in _rules(vs)
+    # the same program is fine when not pinned loop-free
+    vs2, _ = _audit_one(scanny, jnp.float32(1.0))
+    assert "loop-free" not in _rules(vs2)
+
+
+def test_rule_budget_fires_on_eqn_and_gather_and_scatter():
+    import jax.numpy as jnp
+
+    def heavy(x):
+        return (x * 2 + 1) * (x - 3)
+
+    vs, measured = _audit_one(heavy, jnp.float32(1.0), eqn_hi=1)
+    assert "budget" in _rules(vs) and measured["eqns"] > 1
+
+    def gathery(x, idx):
+        return x[idx]
+
+    vs, measured = _audit_one(
+        gathery, jnp.zeros(4, jnp.float32), jnp.zeros(2, jnp.int32),
+        gather_hi=0,
+    )
+    assert "budget" in _rules(vs) and measured["gathers"] >= 1
+
+    def scattery(x, idx):
+        return x.at[idx].add(1.0)
+
+    vs, measured = _audit_one(
+        scattery, jnp.zeros(4, jnp.float32), jnp.zeros(2, jnp.int32),
+        scatter_hi=0,
+    )
+    assert "budget" in _rules(vs) and measured["scatters"] >= 1
+
+
+def test_unknown_program_name_is_an_error():
+    from sparksched_tpu.analysis import jaxpr_audit
+
+    # a typo'd registry name must fail loudly, not silently audit
+    # nothing — the registry and the budget table move together
+    with pytest.raises(ValueError, match="not_a_program"):
+        jaxpr_audit.audit_all(names=("not_a_program",))
+
+
+# ---------------------------------------------------------------------------
+# lint rules: seeded violations (fixture trees mirror the package layout
+# — rule scoping keys on paths relative to the lint root)
+# ---------------------------------------------------------------------------
+
+
+def _lint_tree(tmp_path, files: dict[str, str]):
+    from sparksched_tpu.analysis import lint
+
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return lint.lint_paths(root)
+
+
+def test_rule_host_scalar_fires(tmp_path):
+    vs = _lint_tree(tmp_path, {"env/bad.py": """\
+        import numpy as np
+
+        def f(x):
+            a = x.item()
+            b = np.asarray(x)
+            c = float(x)
+            d = int(x)
+            return a, b, c, d
+    """})
+    got = [v for v in vs if v.rule == "host-scalar"]
+    assert len(got) == 4, vs
+
+
+def test_rule_host_scalar_respects_host_boundaries(tmp_path):
+    vs = _lint_tree(tmp_path, {
+        # the host adapter file is exempt by contract
+        "env/gym_compat.py": "def f(x):\n    return x.item()\n",
+        # host-boundary functions (config coercion, host decision API)
+        "schedulers/ok.py": """\
+            class S:
+                def __init__(self, n):
+                    self.n = int(n)
+
+                def schedule(self, obs):
+                    return int(obs)
+        """,
+        # the line-level pragma escape hatch
+        "env/pragma.py": (
+            "def f(x):\n"
+            "    return x.item()  # analysis: allow(host-scalar)\n"
+        ),
+        # literals are not host pulls
+        "env/lit.py": "def f():\n    return int(3), float('inf')\n",
+    })
+    assert [v for v in vs if v.rule == "host-scalar"] == []
+
+
+def test_rule_host_sync_fires_and_exemptions_hold(tmp_path):
+    vs = _lint_tree(tmp_path, {
+        "trainers/bad.py": """\
+            import jax
+
+            def collect(x):
+                jax.block_until_ready(x)
+                return jax.device_get(x)
+        """,
+        # the sanctioned host loop: obs/ and the trainer host loop —
+        # exemptions are path-qualified, so ONLY trainers/trainer.py's
+        # train() is exempt (a `train` elsewhere still fires, below)
+        "obs/fine.py": "import jax\n\ndef f(x):\n"
+                       "    return jax.device_get(x)\n",
+        "trainers/trainer.py": """\
+            import jax
+
+            def train(x):
+                jax.block_until_ready(x)
+                return jax.device_get(x)
+        """,
+        "env/loop.py": """\
+            import jax
+
+            def train(x):
+                return jax.device_get(x)
+        """,
+        # the from-import form must not bypass the rule
+        "trainers/bad2.py": """\
+            from jax import device_get as dg
+
+            def collect(x):
+                return dg(x)
+        """,
+    })
+    got = [v for v in vs if v.rule == "host-sync"]
+    assert len(got) == 4 and all(
+        "bad.py" in v.where or "bad2.py" in v.where
+        or "env/loop.py" in v.where
+        for v in got
+    ), vs
+
+
+def test_rule_implicit_dtype_fires(tmp_path):
+    vs = _lint_tree(tmp_path, {"env/bad.py": """\
+        import jax.numpy as jnp
+
+        def f(n):
+            a = jnp.zeros(n)
+            b = jnp.ones((n, n))
+            c = jnp.full((n,), 3.0)
+            d = jnp.arange(n)
+            # explicit forms (positional dtype slot or keyword) are fine
+            e = jnp.zeros(n, jnp.int32)
+            f_ = jnp.full((n,), 3.0, jnp.float32)
+            g = jnp.arange(n, dtype=jnp.int32)
+            h = jnp.zeros_like(a)
+            return a, b, c, d, e, f_, g, h
+    """, "env/aliased.py": """\
+        from jax.numpy import zeros
+        import jax.numpy as J
+
+        def f(n):
+            return zeros(n), J.ones(n)
+    """})
+    got = [v for v in vs if v.rule == "implicit-dtype"]
+    assert len(got) == 6, vs
+
+
+def test_rule_time_in_jit_fires(tmp_path):
+    vs = _lint_tree(tmp_path, {
+        "env/bad.py": "import time\n\ndef f():\n    return time.time()\n",
+        # from-import and module-alias forms must not bypass the rule
+        "env/bad2.py": (
+            "from time import perf_counter\n\n"
+            "def f():\n    return perf_counter()\n"
+        ),
+        "env/bad3.py": (
+            "import time as t\n\ndef f():\n    return t.time()\n"
+        ),
+        # host modules may read the clock
+        "trainers/fine.py": (
+            "import time\n\ndef f():\n    return time.perf_counter()\n"
+        ),
+    })
+    got = [v for v in vs if v.rule == "time-in-jit"]
+    assert len(got) == 3 and all("env/bad" in v.where for v in got), vs
+
+
+def test_rule_bare_print_fires(tmp_path):
+    vs = _lint_tree(tmp_path, {
+        "workload/bad.py": "print('hello')\n",
+        "renderer.py": "print('renderer may print')\n",
+        "obs/methods.py": "class A:\n    def print(self):\n        pass\n",
+    })
+    got = [v for v in vs if v.rule == "bare-print"]
+    assert len(got) == 1 and "workload/bad.py" in got[0].where, vs
+
+
+# ---------------------------------------------------------------------------
+# contracts: seeded violations
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_env():
+    import jax
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.workload import make_workload_bank
+
+    params = EnvParams(
+        num_executors=5, max_jobs=6, max_stages=6, max_levels=6,
+        mean_time_limit=2.0e7,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    state = core.reset(params, bank, jax.random.PRNGKey(0))
+    return params, bank, state
+
+
+def test_contract_env_state_schema_fires(small_env):
+    import jax.numpy as jnp
+
+    from sparksched_tpu.analysis import contracts
+
+    params, _, state = small_env
+    assert contracts.check_env_state(state, params) == []
+
+    bad_dtype = state.replace(
+        wall_time=state.wall_time.astype(jnp.float16)
+    )
+    vs = contracts.check_env_state(bad_dtype, params)
+    assert any(
+        v.rule == "env-state-schema" and "wall_time" in v.where
+        for v in vs
+    )
+
+    bad_shape = state.replace(
+        job_supply=jnp.zeros(params.max_jobs + 1, jnp.int32)
+    )
+    vs = contracts.check_env_state(bad_shape, params)
+    assert any("job_supply" in v.where for v in vs)
+
+    with pytest.raises(AssertionError):
+        contracts.assert_env_state(bad_dtype, params)
+
+
+def test_contract_telemetry_schema_fires():
+    import jax.numpy as jnp
+
+    from sparksched_tpu.analysis import contracts
+    from sparksched_tpu.obs.telemetry import telemetry_zeros
+
+    tm = telemetry_zeros()
+    assert contracts.check_telemetry(tm) == []
+    bad = tm.replace(decide_steps=jnp.zeros((), jnp.float32))
+    vs = contracts.check_telemetry(bad)
+    assert vs and vs[0].rule == "telemetry-schema"
+
+    # a counter widened to a vector (shape drift) must fire too — it
+    # changes the scan carry's compile key on every consumer
+    wide = tm.replace(ev_job_arrival=jnp.zeros(3, jnp.int32))
+    vs = contracts.check_telemetry(wide)
+    assert vs and "ev_job_arrival" in vs[0].where
+
+    # vmapped telemetry: lane axes are fine past batch_ndim
+    from sparksched_tpu.obs.telemetry import telemetry_zeros_like
+
+    tb = telemetry_zeros_like((4,))
+    assert contracts.check_telemetry(tb, batch_ndim=1) == []
+    assert contracts.check_telemetry(tb) != []
+
+
+def test_contract_trajectory_schema_fires():
+    import jax
+
+    from sparksched_tpu.analysis import contracts
+
+    # a MicroRec whose lgprob drifted to f64 must fire
+    rec = {
+        k: jax.ShapeDtypeStruct((), dt)
+        for k, (dt, _) in contracts.MICRO_REC_SCHEMA.items()
+    }
+    assert contracts.check_fields(
+        rec, contracts.MICRO_REC_SCHEMA, {}, "MicroRec"
+    ) == []
+    rec["lgprob"] = jax.ShapeDtypeStruct((), "float64")
+    vs = contracts.check_fields(
+        rec, contracts.MICRO_REC_SCHEMA, {}, "MicroRec"
+    )
+    assert vs and vs[0].rule == "trajectory-schema"
+
+    # a leaf added without a schema update is itself a violation (the
+    # f64-smuggled-into-the-rollout-buffer hazard must not hide behind
+    # a schema-keyed projection)
+    rec["lgprob"] = jax.ShapeDtypeStruct((), "float32")
+    rec["value_est"] = jax.ShapeDtypeStruct((), "float64")
+    vs = contracts.check_fields(
+        rec, contracts.MICRO_REC_SCHEMA, {}, "MicroRec"
+    )
+    assert vs and "value_est" in vs[0].where, vs
+
+
+def test_contract_step_invariance_fires(small_env):
+    import jax.numpy as jnp
+
+    from sparksched_tpu.analysis import contracts
+
+    _, _, state = small_env
+    before = contracts.spec_of(state)
+    # an f32 drift on an i32 scalar (an i64 would need x64 enabled —
+    # the astype silently truncates back to i32 on the shipped config)
+    after = contracts.spec_of(
+        state.replace(num_jobs=state.num_jobs.astype(jnp.float32))
+    )
+    vs = contracts.diff_spec(before, after, "EnvState")
+    assert vs and vs[0].rule == "step-invariance"
+    with pytest.raises(AssertionError):
+        contracts.assert_same_spec(before, after)
+    contracts.assert_same_spec(before, before)
+
+
+# ---------------------------------------------------------------------------
+# runtime-assert mode around real episodes (satellite): 500 flat-engine
+# micro-steps and 500 core decision steps, EnvState/Telemetry pinned
+# structure/dtype/shape-invariant at every step on both engines
+# ---------------------------------------------------------------------------
+
+
+def test_flat_engine_500_steps_contract_invariant(small_env):
+    import jax
+
+    from sparksched_tpu.analysis import contracts
+    from sparksched_tpu.env.flat_loop import init_loop_state, micro_step
+    from sparksched_tpu.obs.telemetry import telemetry_zeros
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+
+    params, bank, state = small_env
+
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    @jax.jit
+    def one(ls, key, tm):
+        return micro_step(
+            params, bank, pol, ls, key, True, True, True, 8, True, 1,
+            telemetry=tm,
+        )
+
+    ls = init_loop_state(state)
+    tm = telemetry_zeros()
+    spec0 = contracts.spec_of(ls)
+    tm_spec0 = contracts.spec_of(tm)
+    key = jax.random.PRNGKey(1)
+    for i in range(500):
+        key, sub = jax.random.split(key)
+        ls, tm = one(ls, sub, tm)
+        # cheap metadata-only asserts — no device sync in the loop
+        contracts.assert_same_spec(
+            spec0, contracts.spec_of(ls), f"LoopState@{i}"
+        )
+        contracts.assert_same_spec(
+            tm_spec0, contracts.spec_of(tm), f"Telemetry@{i}"
+        )
+        if i % 100 == 0:
+            contracts.assert_env_state(ls.env, params)
+    contracts.assert_env_state(ls.env, params)
+    assert int(ls.decisions) > 0  # the episode actually progressed
+
+
+def test_core_engine_500_steps_contract_invariant(small_env):
+    import jax
+
+    from sparksched_tpu.analysis import contracts
+    from sparksched_tpu.env import core
+    from sparksched_tpu.env.observe import observe
+    from sparksched_tpu.obs.telemetry import telemetry_zeros
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+
+    params, bank, state = small_env
+
+    @jax.jit
+    def one(st, key, tm):
+        obs = observe(params, st)
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        st, reward, term, trunc, tm = core.step(
+            params, bank, st, si, ne, telemetry=tm
+        )
+        # auto-reset on episode end so all 500 steps exercise live code
+        fresh = core.reset(params, bank, jax.random.fold_in(key, 1))
+        st = jax.tree_util.tree_map(
+            lambda a, b: jax.numpy.where(term | trunc, a, b), fresh, st
+        )
+        return st, tm
+
+    tm = telemetry_zeros()
+    spec0 = contracts.spec_of(state)
+    tm_spec0 = contracts.spec_of(tm)
+    key = jax.random.PRNGKey(2)
+    st = state
+    for i in range(500):
+        key, sub = jax.random.split(key)
+        st, tm = one(st, sub, tm)
+        contracts.assert_same_spec(
+            spec0, contracts.spec_of(st), f"EnvState@{i}"
+        )
+        contracts.assert_same_spec(
+            tm_spec0, contracts.spec_of(tm), f"Telemetry@{i}"
+        )
+        if i % 100 == 0:
+            contracts.assert_env_state(st, params)
+    contracts.assert_env_state(st, params)
+    from sparksched_tpu.analysis.contracts import check_telemetry
+
+    assert check_telemetry(tm) == []
+    assert int(tm.decide_steps) > 0
