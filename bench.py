@@ -127,6 +127,91 @@ CPU_FALLBACK = False
 # stamps false — semantics live in analysis_clean_stamp.
 from sparksched_tpu.analysis import analysis_clean_stamp
 
+# every row additionally carries a `memory` block (ISSUE 5): runtime
+# allocator stats (mem_peak_bytes — null on backends without them) and
+# the lane-fit prediction for the EXACT timed lane program at this
+# row's calibrated knobs (obs/memory.py: two small vmapped traces +
+# a per-buffer linear model — never compiles, never rides the timed
+# window). BENCH_MEMFIT=0 skips the trace-time prediction.
+from sparksched_tpu.obs.memory import (
+    gb,
+    lane_fit,
+    memory_row_stamp,
+)
+
+MEMFIT = os.environ.get("BENCH_MEMFIT", "1") == "1"
+
+
+def _fit_lane_callable(params, bank, bulk_events, fulfill_bulk,
+                       bulk_cycles):
+    """The per-lane program bench_chunk vmaps, rebuilt standalone for
+    the memory pass (bench_chunk's own closure is trace-internal)."""
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    def lane(ls, rng):
+        return run_flat(
+            params, bank, pol, rng, MICRO_CHUNK // BURST,
+            auto_reset=False, compute_levels=False, event_burst=BURST,
+            event_bulk=bulk_events > 0,
+            bulk_events=max(bulk_events, 1),
+            fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
+            loop_state=ls,
+        )
+
+    return lane
+
+
+def _fit_lane_args(params, bank):
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    state = jax.eval_shape(lambda k: core.reset(params, bank, k), key)
+    return (jax.eval_shape(init_loop_state, state), key)
+
+
+def _memory_stamp(params, bank, bulk_events, fulfill_bulk, bulk_cycles):
+    if not MEMFIT:
+        return memory_row_stamp()
+    return memory_row_stamp(
+        _fit_lane_callable(
+            params, bank, bulk_events, fulfill_bulk, bulk_cycles
+        ),
+        _fit_lane_args(params, bank),
+        candidates=tuple(sorted({SUB_BATCH, NUM_ENVS, 1024})),
+    )
+
+
+def _predict_skip_cause(params, bank, bulk_events, fulfill_bulk,
+                        bulk_cycles) -> str | None:
+    """The memory pass's verdict on a failed calibration candidate: is
+    this the single-buffer HBM blowup class (the round-5 19.4 GB OOM)
+    at this sub-batch width, and which buffer dominates. Best-effort —
+    a failed *prediction* must never take the bench down."""
+    if not MEMFIT:
+        return None
+    try:
+        fit = lane_fit(
+            _fit_lane_callable(
+                params, bank, bulk_events, fulfill_bulk, bulk_cycles
+            ),
+            _fit_lane_args(params, bank),
+            candidates=(SUB_BATCH,),
+        )
+        c = fit["candidates"][0]
+        top = c.get("top", {})
+        verdict = (
+            "predicts OOM" if not c["fits"]
+            else "predicts fit (not a single-buffer HBM blowup)"
+        )
+        return (
+            f"memory pass {verdict} at {SUB_BATCH} lanes: est "
+            f"~{gb(c['est_peak_bytes'])} GB vs "
+            f"{gb(fit['budget_bytes'])} GB budget; dominant buffer "
+            f"{top.get('op')} {top.get('shape')}"
+        )
+    except Exception:
+        return None
+
 
 def _metric_suffix() -> str:
     if CPU_FALLBACK:
@@ -304,6 +389,8 @@ def main() -> None:
         cands = list(dict.fromkeys(cands))
     telem = telemetry_zeros_like((NUM_ENVS,)) if TELEMETRY else None
 
+    skipped_candidates: list[dict] = []
+
     def warm_candidates(cands, loop_states, telem):
         keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
         ok = []
@@ -315,13 +402,24 @@ def main() -> None:
                 )
                 jax.block_until_ready(n)
             except Exception as err:
+                # not a bare skip: ask the memory pass whether this is
+                # the HBM-blowup failure class and which buffer — the
+                # round-5 OOM's postmortem, available at skip time
+                cause = _predict_skip_cause(params, bank, be, fb, bc)
                 print(
                     f"# bench: candidate bulk_events={be} "
                     f"fulfill_bulk={fb} bulk_cycles={bc} skipped at "
                     f"sub-batch {SUB_BATCH} "
-                    f"({type(err).__name__}: {str(err)[:200]})",
+                    f"({type(err).__name__}: {str(err)[:200]})"
+                    + (f"; {cause}" if cause else ""),
                     file=sys.stderr, flush=True,
                 )
+                skipped_candidates.append({
+                    "bulk_events": int(be), "fulfill_bulk": bool(fb),
+                    "bulk_cycles": int(bc), "sub_batch": SUB_BATCH,
+                    "error": type(err).__name__,
+                    "mem_predicted": cause,
+                })
             else:
                 loop_states = ls_try
                 telem = tm_try
@@ -438,6 +536,17 @@ def main() -> None:
             "telemetry": TELEMETRY,
         },
     }
+    if skipped_candidates:
+        # a row whose calibration silently dropped candidates is not
+        # comparable with one that tried them all — the skip list (with
+        # the memory pass's per-candidate verdict) rides the row
+        row["config"]["skipped_candidates"] = skipped_candidates
+    # runtime allocator stats + the lane-fit prediction for the exact
+    # timed program at the calibrated knobs; computed AFTER the timed
+    # window (the two small traces must not ride the measured chunks)
+    row["memory"] = _memory_stamp(
+        params, bank, bulk_events, fulfill_bulk, bulk_cycles
+    )
     if TELEMETRY:
         # micro-step composition + straggler ratio over the timed
         # window, from the same module every bench row stamps from

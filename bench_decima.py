@@ -53,6 +53,83 @@ TELEMETRY = os.environ.get("BENCH_TELEMETRY", "1") == "1"
 # — semantics live in sparksched_tpu/analysis:analysis_clean_stamp)
 from sparksched_tpu.analysis import analysis_clean_stamp  # noqa: E402
 
+# `memory` block on every row (ISSUE 5): runtime allocator stats
+# (mem_peak_bytes, null off-chip) + the lane-fit prediction for the
+# row's own collection program — the per-lane collectors fit via
+# vmap-tracing, the batch (fastpath) collector via a batched tracer,
+# and the PPO rows via the memoized registry micro_step proxy (their
+# collection program is the trainer's own jit). BENCH_MEMFIT=0 skips
+# the trace-time predictions; runtime stats are always stamped.
+from sparksched_tpu.obs.memory import memory_row_stamp  # noqa: E402
+
+MEMFIT = os.environ.get("BENCH_MEMFIT", "1") == "1"
+
+
+def _registry_proxy_stamp() -> dict:
+    """Memory stamp for rows without a per-lane collection program:
+    allocator stats + the registry micro_step lane-fit (memoized in
+    sparksched_tpu/analysis/memory.py, labeled so the row cannot be
+    read as a fit of the trainer's own jit)."""
+    out = memory_row_stamp()
+    if not MEMFIT:
+        return out
+    try:
+        from sparksched_tpu.analysis.memory import registry_lane_fit
+
+        out["lane_fit"] = {"program": "registry:micro_step"} | (
+            registry_lane_fit(("micro_step",))["micro_step"]
+        )
+    except Exception as e:
+        out["lane_fit"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    return out
+
+
+def _inference_mem_stamp(params, bank, engine, steps, pol, bpol, knobs,
+                         micro_groups, states) -> dict:
+    """Per-row memory block for the inference benches: the row's own
+    collection program, lane-fitted at the production lane range."""
+    if not MEMFIT:
+        return memory_row_stamp()
+    from sparksched_tpu.trainers.rollout import (
+        collect_flat_sync,
+        collect_flat_sync_batch,
+        collect_sync,
+    )
+
+    state1 = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), states
+    )
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    cands = (64, 256, 1024)
+    if engine == "fastpath":
+        def tracer(b):
+            st_b = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(
+                    (b,) + tuple(l.shape), l.dtype
+                ),
+                state1,
+            )
+            return jax.make_jaxpr(
+                lambda s, k: collect_flat_sync_batch(
+                    params, bank, bpol, k, steps, s, None,
+                    fulfill_bulk=knobs["fulfill_bulk"],
+                    bulk_events=knobs["bulk_events"],
+                    bulk_cycles=knobs["bulk_cycles"],
+                )
+            )(st_b, key)
+
+        return memory_row_stamp(tracer=tracer, candidates=cands)
+    if engine == "flat":
+        def fn(r, s):
+            return collect_flat_sync(
+                params, bank, pol, r, steps, s, None,
+                micro_groups=micro_groups, **knobs,
+            )
+    else:
+        def fn(r, s):
+            return collect_sync(params, bank, pol, r, steps, s, None)
+    return memory_row_stamp(fn, (key, state1), candidates=cands)
+
 
 def _flat_knobs() -> dict:
     """Flat-engine calibration knobs for the decima_flat rows (same
@@ -231,6 +308,13 @@ def bench_inference(
         }
     if engine == "flat":
         cfg |= {"micro_per_decision": micro_per_dec} | knobs
+    if engine == "fastpath":
+        # the stamp must fit the WINNING bucket's program (the
+        # calibration loop left sched.job_bucket at the last candidate)
+        sched.job_bucket = int(job_bucket)
+        bpol_fit = sched.flat_batch_policy()
+    else:
+        bpol_fit = None
     row = {
         "metric": f"decima_infer_steps_per_sec_{num_envs}envs{tag}"
                   f"{eng_tag}",
@@ -239,6 +323,10 @@ def bench_inference(
         "vs_baseline": round(value / TARGET, 3),
         "analysis_clean": analysis_clean_stamp(),
         "config": cfg,
+        "memory": _inference_mem_stamp(
+            params, bank, engine, steps, pol, bpol_fit, knobs,
+            micro_groups if engine == "flat" else None, states,
+        ),
     }
     if TELEMETRY:
         row["telemetry"] = summarize(telem, prev=telem_snap)
@@ -356,6 +444,7 @@ def bench_ppo(
             "backend": jax.default_backend(),
             "telemetry": TELEMETRY,
         },
+        "memory": _registry_proxy_stamp(),
     }
     if summaries:
         row["telemetry"] = summaries[-1]

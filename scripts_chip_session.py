@@ -23,6 +23,11 @@ Stages (each guarded; a failure logs and moves on):
   10. static-analysis gate (sparksched_tpu/analysis): jaxpr audit +
      AST lint + pytree contracts in a CPU-pinned subprocess — chip-safe
      (never claims the device client), so it can run at any point
+  11. on-chip memory capture (ISSUE 5): AOT-compile every registered
+     hot program on the real backend, extract
+     compiled.memory_analysis() (argument/output/temp bytes — the
+     numbers XLA:CPU folds away) plus device memory_stats(), into
+     artifacts/memory_chip.json. Claims the device client.
 
 Every bench row (stages 3/4/8) is stamped with the on-device telemetry
 summary — micro-step composition, straggler ratio, events/decision —
@@ -324,6 +329,44 @@ def stage_analysis():
         print(r.stderr.decode(errors="replace")[-4000:], flush=True)
 
 
+def stage_memory_capture():
+    """Backend-true memory accounting for every registered hot program
+    (sparksched_tpu/analysis/memory.py registry): AOT lower + compile on
+    THIS backend, extract compiled.memory_analysis(), and sample the
+    allocator's memory_stats(). On the TPU these are the bytes the
+    CPU-pinned trace-time pass can only model (tile padding, fusion);
+    the artifact is the ground truth the MEM_BUDGETS bands and the
+    lane-fit advisor are calibrated against. Per-program guards: one
+    failed compile records its error and moves on."""
+    _mark_client_held()
+    import json
+    import os
+
+    from sparksched_tpu.analysis.memory import program_memory_accounting
+    from sparksched_tpu.obs.memory import device_memory_stats
+
+    t0 = time.time()
+    out = {
+        "memory_analysis": program_memory_accounting(),
+        "memory_stats": device_memory_stats(),
+        "backend": jax.default_backend(),
+    }
+    os.makedirs("artifacts", exist_ok=True)
+    path = "artifacts/memory_chip.json"
+    with open(path, "w") as fp:
+        json.dump(out, fp, indent=1)
+    n_ok = sum(
+        1 for v in out["memory_analysis"].values()
+        if isinstance(v, dict) and "error" not in v
+    )
+    print(
+        f"[memory] wrote {path} in {time.time() - t0:.0f}s "
+        f"({n_ok} programs compiled on {out['backend']}; "
+        f"memory_stats={'yes' if out['memory_stats'] else 'n/a'})",
+        flush=True,
+    )
+
+
 STAGES = {
     "1": ("sanity", stage_sanity),
     "2": ("burst sweep", stage_sweep),
@@ -335,6 +378,7 @@ STAGES = {
     "8": ("decima flat-engine benches", stage_bench_decima_flat),
     "9": ("labeled device trace", stage_obs_trace),
     "10": ("static-analysis gate", stage_analysis),
+    "11": ("on-chip memory capture", stage_memory_capture),
 }
 
 

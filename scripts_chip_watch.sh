@@ -25,6 +25,8 @@ rm -f /tmp/stop_chip_watch  # consume any stale stop request at launch
 # marker must not survive watcher restarts, or a restarted watcher in
 # the same round never re-measures after calibration changes
 rm -f /tmp/headline_r05_remeasured
+# same per-lifetime semantics for the on-chip memory capture (stage 11)
+rm -f /tmp/memcap_done
 # one-time legacy sweep: earlier-round trainers (tracked only by name,
 # pre-PID-file) must not survive into this watcher's lifetime — they
 # would contend the single core untracked and never be stopped for
@@ -142,6 +144,20 @@ print('ALIVE')
     rc=$?
     echo "decima-flat-bench rc=$rc at $(date +%H:%M:%S)"
     [ "$rc" -eq 124 ] && echo "TRUNCATION_EXPECTED: stage 8 hit its 2700s budget; trailing rows were cut by the watcher, not by row failures"
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time on-chip memory capture (ISSUE 5, stage 11): the
+    # compiled.memory_analysis() bytes only the real backend can
+    # produce — the ground truth the CPU-pinned memory pass's budgets
+    # and lane-fit model are calibrated against. Once per watcher
+    # lifetime so later windows keep going to benches + training.
+    MEMCAP_MARK=/tmp/memcap_done
+    if [ ! -f "$MEMCAP_MARK" ]; then
+      timeout -k 60 1800 python scripts_chip_session.py 11 \
+        | tee /tmp/memcap_last.log
+      echo "memcap rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q "wrote artifacts/memory_chip.json" /tmp/memcap_last.log \
+        && touch "$MEMCAP_MARK"
+    fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable
     # sessions (state saved every session; a wedge mid-session loses at

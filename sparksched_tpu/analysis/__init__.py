@@ -26,11 +26,19 @@ package makes TPU-hostility a CI failure, via three passes:
   schemas are data the auditor reads via `jax.eval_shape`) plus a
   cheap runtime-assert mode tests use to pin that reset/step never
   drift structure, dtype, or shape (the recompile hazard).
+- `memory`: HBM-byte observability (ISSUE 5 tentpole) — per-program
+  trace-time byte accounting under the TPU tiled-layout model, the
+  `bank-broadcast` rule (no vmapped lane program may contain a
+  lane-batched producer of a workload-bank-shaped array — the 19.4 GB
+  round-5 OOM, checkable on CPU before backend folding), a
+  declarative temp-bytes budget table, and the lane-fit advisor (max
+  vmap lanes per program under a 17.2 GB HBM budget).
 
 `python -m sparksched_tpu.analysis` runs all passes, prints a JSON
 report, and exits non-zero on any violation. Budgets and rule scoping
 are declarative data in the respective modules; see
-`jaxpr_audit.BUDGETS` for the re-pin procedure.
+`jaxpr_audit.BUDGETS` and `memory.MEM_BUDGETS` for the re-pin
+procedures.
 """
 
 from __future__ import annotations
@@ -64,15 +72,24 @@ class Violation:
         return f"[{self.passname}/{self.rule}] {self.where}: {self.detail}"
 
 
-def run_all(passes: tuple[str, ...] = ("lint", "contracts", "jaxpr"),
+DEFAULT_PASSES = ("lint", "contracts", "jaxpr", "memory")
+
+
+def run_all(passes: tuple[str, ...] = DEFAULT_PASSES,
+            programs: tuple[str, ...] | None = None,
             ) -> dict[str, Any]:
     """Run the selected passes and return the JSON-able report dict.
 
     Pass order is cheap-first (lint is pure AST, contracts is
     `eval_shape`-only, the jaxpr audit traces every registered hot
-    program) so a dirty tree fails fast. The heavy imports happen here,
-    not at module import, so `from sparksched_tpu import analysis`
-    stays light for the bench stamp helper."""
+    program, the memory pass additionally traces the VMAPPED lane
+    programs — it reuses the jaxpr pass's unbatched traces via the
+    registry cache, so running both costs one set of traces plus the
+    vmapped ones) so a dirty tree fails fast. `programs` restricts the
+    jaxpr/memory registries (the lint/contracts passes ignore it). The
+    heavy imports happen here, not at module import, so `from
+    sparksched_tpu import analysis` stays light for the bench stamp
+    helper."""
     report: dict[str, Any] = {"passes": {}, "violations": []}
     all_violations: list[Violation] = []
     for p in passes:
@@ -89,7 +106,12 @@ def run_all(passes: tuple[str, ...] = ("lint", "contracts", "jaxpr"),
         elif p == "jaxpr":
             from . import jaxpr_audit
 
-            vs, measured = jaxpr_audit.audit_all()
+            vs, measured = jaxpr_audit.audit_all(names=programs)
+            extra = {"measured": measured}
+        elif p == "memory":
+            from . import memory
+
+            vs, measured = memory.audit_memory(names=programs)
             extra = {"measured": measured}
         else:
             raise ValueError(f"unknown pass {p!r}")

@@ -2,11 +2,21 @@
 print a JSON report, exit non-zero on any violation.
 
 Flags:
-  --passes lint,contracts,jaxpr   subset to run (default: all,
-                                  cheap-first)
+  --passes lint,contracts,jaxpr,memory
+                                  subset to run (default: all,
+                                  cheap-first); `--passes memory` runs
+                                  the HBM memory pass alone
   --quiet                         violations-only JSON (no measured
                                   counts) — the bench stamp subprocess
                                   uses this
+  --programs observe,micro_step   registry subset for the jaxpr/memory
+                                  passes (default: all 7; unknown names
+                                  are an error)
+  --mem-compile                   additionally AOT-compile every
+                                  registry program on the current
+                                  backend and report
+                                  compiled.memory_analysis() (backend-
+                                  true bytes; roughly doubles runtime)
 Exit code 0 == analysis-clean tree.
 
 JAX_PLATFORMS defaults to cpu (tracing is backend-independent, and the
@@ -29,12 +39,23 @@ def main(argv: list[str] | None = None) -> int:
         "lint + pytree contracts)",
     )
     ap.add_argument(
-        "--passes", default="lint,contracts,jaxpr",
-        help="comma-separated subset of lint,contracts,jaxpr",
+        "--passes", default="lint,contracts,jaxpr,memory",
+        help="comma-separated subset of lint,contracts,jaxpr,memory",
     )
     ap.add_argument(
         "--quiet", action="store_true",
         help="violations-only JSON (omit measured counts)",
+    )
+    ap.add_argument(
+        "--programs", default=None,
+        help="comma-separated registry subset for the jaxpr/memory "
+        "passes (default: every registered hot program)",
+    )
+    ap.add_argument(
+        "--mem-compile", action="store_true",
+        help="AOT-compile the registry and report backend-true "
+        "memory_analysis() bytes (chip session stage 11 uses this "
+        "on-device; the default stays trace-only and CPU-pinned)",
     )
     args = ap.parse_args(argv)
 
@@ -44,7 +65,15 @@ def main(argv: list[str] | None = None) -> int:
     from . import run_all
 
     passes = tuple(p for p in args.passes.split(",") if p)
-    report = run_all(passes)
+    programs = (
+        tuple(p for p in args.programs.split(",") if p)
+        if args.programs else None
+    )
+    report = run_all(passes, programs=programs)
+    if args.mem_compile:
+        from .memory import program_memory_accounting
+
+        report["mem_compile"] = program_memory_accounting(programs)
     if args.quiet:
         report = {
             "clean": report["clean"],

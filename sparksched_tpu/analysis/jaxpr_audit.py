@@ -310,11 +310,20 @@ def _batched(tree, b: int):
     )
 
 
-def build_programs(names: tuple[str, ...] | None = None
-                   ) -> dict[str, Any]:
-    """Trace the registered hot programs; returns name -> ClosedJaxpr.
-    Order is cheap-first. `names` restricts the registry (the thin
-    test wrappers trace only what they pin)."""
+# per-lane programs of the registry: the ones that run under a lane
+# vmap in production (bench.py / the flat collectors), and therefore
+# the ones the memory pass lane-batches for the bank-broadcast rule
+# and the lane-fit advisor
+LANE_PROGRAMS = (
+    "observe", "micro_step", "decide_micro_step", "drain_to_decision",
+)
+
+
+def lane_callables() -> dict[str, tuple[Callable, tuple]]:
+    """The per-lane registry programs as (callable, UNBATCHED abstract
+    args) — shared by the unbatched jaxpr trace below and the memory
+    pass's vmapped traces, so the two passes cannot audit different
+    programs under the same name."""
     import jax
     import jax.numpy as jnp
 
@@ -325,7 +334,6 @@ def build_programs(names: tuple[str, ...] | None = None
         micro_step,
     )
     from ..env.observe import observe
-    from ..schedulers.decima import DecimaScheduler
     from ..schedulers.heuristics import round_robin_policy
 
     params, bank, state = audit_setup()
@@ -337,37 +345,53 @@ def build_programs(names: tuple[str, ...] | None = None
         si, ne = round_robin_policy(obs, params.num_executors, True)
         return si, ne, {}
 
+    return {
+        "observe": (lambda s: observe(params, s), (state,)),
+        # the shipped bulk config: be=8, fulfill_bulk on, one cycle
+        # (compute_levels=False as in bench.py's driving loop)
+        "micro_step": (
+            lambda l, r: micro_step(
+                params, bank, pol, l, r, True, False, True, 8, True, 1
+            ),
+            (ls, key),
+        ),
+        "decide_micro_step": (
+            lambda l, si, ne, r: decide_micro_step(
+                params, bank, l, si, ne, r, True, True
+            ),
+            (ls, i32, i32, key),
+        ),
+        "drain_to_decision": (
+            lambda l, r: drain_to_decision(
+                params, bank, l, r, True, True, 8, 1
+            ),
+            (ls, key),
+        ),
+    }
+
+
+_PROGRAMS_CACHE: dict = {}
+
+
+def program_callables(names: tuple[str, ...] | None = None
+                      ) -> dict[str, tuple[Callable, tuple]]:
+    """Every registered hot program as (callable, abstract args) —
+    the single registry behind the unbatched jaxpr traces (this pass),
+    the memory pass's vmapped traces, and the chip session's on-device
+    `memory_analysis()` capture."""
+    import jax
+
+    from ..env.observe import observe
+    from ..schedulers.decima import DecimaScheduler
+
+    params, bank, state = audit_setup()
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
     want = set(names) if names is not None else None
-    programs: dict[str, Any] = {}
 
-    def trace(name: str, fn: Callable, *args) -> None:
+    out: dict[str, tuple[Callable, tuple]] = {}
+    for name, entry in lane_callables().items():
         if want is None or name in want:
-            programs[name] = jax.make_jaxpr(fn)(*args)
-
-    trace("observe", lambda s: observe(params, s), state)
-    # the shipped bulk config: be=8, fulfill_bulk on, one cycle
-    # (compute_levels=False as in bench.py's driving loop)
-    trace(
-        "micro_step",
-        lambda l, r: micro_step(
-            params, bank, pol, l, r, True, False, True, 8, True, 1
-        ),
-        ls, key,
-    )
-    trace(
-        "decide_micro_step",
-        lambda l, si, ne, r: decide_micro_step(
-            params, bank, l, si, ne, r, True, True
-        ),
-        ls, i32, i32, key,
-    )
-    trace(
-        "drain_to_decision",
-        lambda l, r: drain_to_decision(
-            params, bank, l, r, True, True, 8, 1
-        ),
-        ls, key,
-    )
+            out[name] = entry
 
     if want is None or want & {"decima_score", "decima_batch_policy"}:
         # compaction bucket scaled to the audit job cap (flagship K=32
@@ -384,25 +408,54 @@ def build_programs(names: tuple[str, ...] | None = None
         feats_b = jax.eval_shape(
             lambda o: jax.vmap(sched.features)(o), obs_b
         )
-        trace(
-            "decima_score",
-            lambda f: sched.score(sched.params, f), feats_b,
-        )
-        trace(
-            "decima_batch_policy",
-            lambda r, o: sched.batch_policy(r, o), key, obs_b,
-        )
+        if want is None or "decima_score" in want:
+            out["decima_score"] = (
+                lambda f: sched.score(sched.params, f), (feats_b,)
+            )
+        if want is None or "decima_batch_policy" in want:
+            out["decima_batch_policy"] = (
+                lambda r, o: sched.batch_policy(r, o), (key, obs_b)
+            )
 
     if want is None or "ppo_update" in want:
-        programs["ppo_update"] = _trace_ppo_update()
+        out["ppo_update"] = ppo_update_callable()
+    return out
+
+
+def build_programs(names: tuple[str, ...] | None = None
+                   ) -> dict[str, Any]:
+    """Trace the registered hot programs; returns name -> ClosedJaxpr.
+    Order is cheap-first. `names` restricts the registry (the thin
+    test wrappers trace only what they pin). The full-registry result
+    is memoized per process: the jaxpr and memory passes both consume
+    it, and re-tracing ~15k equations for the second pass would double
+    the gate's cost for identical jaxprs."""
+    import jax
+
+    if names is None and _PROGRAMS_CACHE:
+        return dict(_PROGRAMS_CACHE)
+    programs = {
+        name: jax.make_jaxpr(fn)(*args)
+        for name, (fn, args) in program_callables(names).items()
+    }
+    if names is None:
+        _PROGRAMS_CACHE.update(programs)
     return programs
 
 
 def _trace_ppo_update():
-    """Trace one PPO update at a tiny audit scale (2 lanes, 16 decision
-    steps). The rollout is abstract (`eval_shape` over `_collect`), so
-    nothing episode-sized executes; `make_jaxpr(_update)` then traces
-    the real epochs x minibatches scan with the remat'd GNN recompute."""
+    import jax
+
+    fn, args = ppo_update_callable()
+    return jax.make_jaxpr(fn)(*args)
+
+
+def ppo_update_callable() -> tuple[Callable, tuple]:
+    """One PPO update at a tiny audit scale (2 lanes, 16 decision
+    steps), as (callable, abstract args). The rollout is abstract
+    (`eval_shape` over `_collect`), so nothing episode-sized executes;
+    tracing/lowering the callable then hits the real epochs x
+    minibatches scan with the remat'd GNN recompute."""
     import jax
     import jax.numpy as jnp
 
@@ -440,7 +493,7 @@ def _trace_ppo_update():
         lambda p, i, r: trainer._collect(p, i, r, None),
         state.params, it, key,
     )
-    return jax.make_jaxpr(trainer._update)(state, ro)
+    return trainer._update, (state, ro)
 
 
 def audit_all(names: tuple[str, ...] | None = None

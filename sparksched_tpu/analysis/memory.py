@@ -1,0 +1,319 @@
+"""Memory pass: HBM-byte accounting, the bank-broadcast rule, and the
+lane-fit advisor over the registered hot programs.
+
+The round-5 flagship bench died in XLA allocation analysis with a
+19.4 GB temp — a per-lane broadcast of the workload bank's duration
+table (`f32[512,154,20,3,8,16]`) that XLA:CPU folds away, so no CPU
+test, bench or calibration run could see it (PERF.md "Round-3 on-chip
+session 1"; fixed by commit 81e77fb). This pass makes that class of
+failure a CPU-checkable CI failure, with the same shape as the eqn
+budgets in `jaxpr_audit`:
+
+Rules (ids used in the JSON report and the fixture tests):
+
+- ``bank-broadcast``: no vmapped lane program (`observe`,
+  `micro_step`, `decide_micro_step`, `drain_to_decision` — the
+  registry programs that run under a lane vmap in production) may
+  contain a lane-batched producer of a workload-bank-shaped array
+  (`dur[T,S,3,L,K]`, `cnt[T,S,3,L]`, `adj[T,S,S]` with a leading lane
+  dim). jax's cond/switch batching broadcasts closed-over operands
+  when the predicate is lane-dependent, so a bank access inside a
+  lane-dependent branch materializes a per-lane table copy — the
+  exact invariant 81e77fb restored, checked on the JAXPR (before
+  backend folding) so CPU CI sees what the TPU would allocate.
+- ``mem-budget``: per-program `temp_total_bytes` (the tile-padded sum
+  over every intermediate buffer of the UNBATCHED program at audit
+  shapes — no liveness model, but stable and monotone in program
+  growth) within the declarative `MEM_BUDGETS` bands below.
+
+The report additionally carries, per program: the full trace-time
+byte accounting (`obs.memory.jaxpr_memory_estimate` — args / outputs /
+consts / temp-total / peak lower bound and a top-K largest-buffer
+attribution naming shape + producing op), and for the lane programs a
+lane-fit table (max lanes under the `TPU_HBM_BUDGET_BYTES` budget,
+default 17.2 GB = the v5-lite part in PERF.md).
+
+Backend-true accounting (`compiled.memory_analysis()` after a real AOT
+compile) is NOT part of the default pass — it is backend-dependent
+(CPU folds, TPU pads) and compiling all seven programs would roughly
+double the gate's cost. `program_memory_accounting(compile=True)`
+exposes it for the chip session (stage 11) and the CLI's
+`--mem-compile` flag.
+
+Re-pin procedure (same contract as jaxpr_audit.BUDGETS): run
+`python -m sparksched_tpu.analysis` — the report's
+`passes.memory.measured` block prints every program's measured
+temp-total bytes. A deliberate change that moves a program's bytes
+gets a new cap of ~1.35x the measured value IN THE SAME PR, with a
+bench row justifying the growth (PERF.md "Memory"). Bands are loose:
+byte totals drift a few percent across jax versions as fusion
+boundaries move; a band breach means structural allocation growth
+(a new lane-batched table, a widened buffer), not noise.
+
+Pinned 2026-08 (jax 0.4.37, threefry, CPU trace, tile-padded audit
+shapes) — measured temp-total MB: observe 2.3, micro_step 22.1,
+decide_micro_step 9.9, drain_to_decision 16.2, decima_score 153.6,
+decima_batch_policy 169.2, ppo_update 269.6. (The decima/ppo programs
+carry a 4-lane batch in their audited shapes, and tile padding
+inflates narrow minor dims — these are model numbers for regression
+detection, not literal HBM footprints; the lane-fit table is the
+footprint story.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from . import Violation
+from .jaxpr_audit import (
+    LANE_PROGRAMS,
+    audit_setup,
+    build_programs,
+    lane_callables,
+    program_callables,
+)
+from ..obs.memory import (
+    TPU_HBM_BUDGET_BYTES,
+    _iter_eqns,
+    _trace_vmapped,
+    aot_memory,
+    aval_bytes,
+    gb,
+    jaxpr_memory_estimate,
+    lane_fit,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MemBudget:
+    """Per-program byte budget: `temp_hi` bounds the tile-padded sum of
+    intermediate buffer bytes of the unbatched program at audit shapes
+    (`obs.memory.jaxpr_memory_estimate`'s `temp_total_bytes`)."""
+
+    temp_hi: int
+
+
+MB = 10**6
+
+# ---------------------------------------------------------------------------
+# THE bytes budget table (single source of truth; see the module
+# docstring for the re-pin procedure). Caps are ~1.35x the measured
+# value, matching the eqn-budget band policy.
+# ---------------------------------------------------------------------------
+
+MEM_BUDGETS: dict[str, MemBudget] = {
+    "observe": MemBudget(temp_hi=4 * MB),
+    "micro_step": MemBudget(temp_hi=30 * MB),
+    "decide_micro_step": MemBudget(temp_hi=14 * MB),
+    "drain_to_decision": MemBudget(temp_hi=22 * MB),
+    "decima_score": MemBudget(temp_hi=210 * MB),
+    "decima_batch_policy": MemBudget(temp_hi=230 * MB),
+    "ppo_update": MemBudget(temp_hi=365 * MB),
+}
+
+# lane counts the advisor sweeps (the bench's production range; 1024
+# is the headline lane count, 512 the sub-batch the round-5 OOM hit)
+LANE_FIT_CANDIDATES = (64, 128, 256, 512, 1024)
+# lane counts the vmapped traces are built at: B=4 feeds the
+# bank-broadcast scan, (2, 4) the advisor's linear model
+AUDIT_LANES = (2, 4)
+
+
+def bank_shapes(bank) -> dict[str, tuple[int, ...]]:
+    """The workload-bank array shapes whose lane-batched materialization
+    is the hazard (the same trio tests/test_vmap_memory.py greps for)."""
+    return {
+        "dur": tuple(bank.dur.shape),
+        "cnt": tuple(bank.cnt.shape),
+        "adj": tuple(bank.adj.shape),
+    }
+
+
+def check_bank_broadcast(name: str, closed, bank, lanes: int
+                         ) -> list[Violation]:
+    """Scan one VMAPPED program's jaxpr for equations producing a
+    lane-batched bank-shaped array. Names the producing op and the
+    would-be HBM cost at the headline lane count, so the report reads
+    like the round-5 postmortem instead of a six-dim shape."""
+    hazard = {
+        (lanes,) + shape: table
+        for table, shape in bank_shapes(bank).items()
+    }
+    found: list[Violation] = []
+    seen: set[tuple] = set()
+    for eqn in _iter_eqns(closed.jaxpr):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            shape = tuple(getattr(aval, "shape", ()))
+            if shape not in hazard:
+                continue
+            import jax
+
+            key = (eqn.primitive.name, shape, str(aval.dtype))
+            if key in seen:
+                continue
+            seen.add(key)
+            at_1024 = aval_bytes(
+                jax.ShapeDtypeStruct((1024,) + shape[1:], aval.dtype)
+            )
+            found.append(Violation(
+                "memory", "bank-broadcast", name,
+                f"lane-batched producer of the bank's {hazard[shape]} "
+                f"table: {eqn.primitive.name} -> {aval.dtype}"
+                f"{list(shape)} under a {lanes}-lane vmap "
+                f"(~{gb(at_1024)} GB tile-padded at 1024 lanes) — a "
+                "bank access moved inside a lane-dependent cond/switch "
+                "branch; hoist it to the shared micro-step tail "
+                "(commit 81e77fb pattern)",
+            ))
+    return found
+
+
+def _lane_traces(names: tuple[str, ...] | None = None
+                 ) -> dict[str, dict[int, Any]]:
+    """Vmapped ClosedJaxprs of the lane programs at AUDIT_LANES —
+    built once and shared between the bank-broadcast rule and the
+    lane-fit advisor (each heavy trace costs seconds)."""
+    out: dict[str, dict[int, Any]] = {}
+    for name, (fn, args) in lane_callables().items():
+        if names is not None and name not in names:
+            continue
+        out[name] = {
+            b: _trace_vmapped(fn, args, b) for b in AUDIT_LANES
+        }
+    return out
+
+
+def audit_memory(
+    names: tuple[str, ...] | None = None,
+    budget_bytes: int = TPU_HBM_BUDGET_BYTES,
+) -> tuple[list[Violation], dict[str, Any]]:
+    """Run the memory pass over the registry (or the `names` subset).
+    Returns (violations, measured dict for the report): per-program
+    byte accounting + budget verdicts, bank-broadcast scan of the
+    vmapped lane programs, and the lane-fit table."""
+    if names is not None:
+        unknown = set(names) - set(MEM_BUDGETS)
+        if unknown:
+            raise ValueError(
+                f"unknown program name(s) {sorted(unknown)} — the "
+                "registry is the MEM_BUDGETS table's key set"
+            )
+    _, bank, _ = audit_setup()
+    found: list[Violation] = []
+    measured: dict[str, Any] = {}
+
+    # -- unbatched accounting + the bytes budget ------------------------
+    for name, closed in build_programs(names).items():
+        est = jaxpr_memory_estimate(closed, tile_pad=True, top_k=3)
+        budget = MEM_BUDGETS.get(name)
+        measured[name] = {
+            "temp_total_bytes": est["temp_total_bytes"],
+            "temp_total_mb": round(est["temp_total_bytes"] / MB, 1),
+            "args_bytes": est["args_bytes"],
+            "out_bytes": est["out_bytes"],
+            "const_bytes": est["const_bytes"],
+            "peak_lower_bound_bytes": est["peak_lower_bound_bytes"],
+            "largest": est["largest"],
+        }
+        if budget is None:
+            found.append(Violation(
+                "memory", "mem-budget", name,
+                "program has no entry in the MEM_BUDGETS table",
+            ))
+        elif est["temp_total_bytes"] > budget.temp_hi:
+            top = est["largest"][0] if est["largest"] else {}
+            found.append(Violation(
+                "memory", "mem-budget", name,
+                f"temp-total {round(est['temp_total_bytes'] / MB, 1)}"
+                f" MB > cap {round(budget.temp_hi / MB, 1)} MB "
+                f"(largest buffer: {top.get('op')} "
+                f"{top.get('shape')} = "
+                f"{round(top.get('bytes', 0) / MB, 2)} MB) — "
+                "structural allocation growth (or a stale cap); "
+                "re-measure and re-pin in the same PR with a bench "
+                "row justifying it",
+            ))
+
+    # -- vmapped lane programs: bank-broadcast + lane-fit ---------------
+    lane_names = tuple(
+        n for n in LANE_PROGRAMS if names is None or n in names
+    )
+    if lane_names:
+        traces = _lane_traces(lane_names)
+        callables = lane_callables()
+        b_scan = max(AUDIT_LANES)
+        lane_report: dict[str, Any] = {}
+        for name in lane_names:
+            found.extend(check_bank_broadcast(
+                name, traces[name][b_scan], bank, b_scan
+            ))
+            fn, args = callables[name]
+            fit = lane_fit(
+                fn, args, candidates=LANE_FIT_CANDIDATES,
+                budget_bytes=budget_bytes, base_lanes=AUDIT_LANES,
+                traced=traces[name],
+            )
+            lane_report[name] = fit
+            measured[name]["lane_fit"] = {
+                "budget_gb": gb(budget_bytes),
+                "max_lanes_fit": fit["max_lanes_fit"],
+                "at_1024_gb": next(
+                    (gb(r["est_peak_bytes"])
+                     for r in fit["candidates"] if r["lanes"] == 1024),
+                    None,
+                ),
+            }
+    return found, measured
+
+
+_REGISTRY_FIT_CACHE: dict = {}
+
+
+def registry_lane_fit(
+    names: tuple[str, ...] = ("micro_step",),
+    budget_bytes: int = TPU_HBM_BUDGET_BYTES,
+) -> dict[str, Any]:
+    """Memoized compact lane-fit of registry lane programs — the stamp
+    bench rows use when their own collection program has no per-lane
+    form (the single-eval batch collectors, the trainer's PPO jit): the
+    registry micro-step/decide programs are the HBM-dominant inner loop
+    every engine shares, so their fit is the honest proxy. Memoized per
+    process because each program costs two heavy vmapped traces."""
+    from ..obs.memory import lane_fit_summary
+
+    key = (tuple(names), int(budget_bytes))
+    if key not in _REGISTRY_FIT_CACHE:
+        callables = lane_callables()
+        _REGISTRY_FIT_CACHE[key] = {
+            name: lane_fit_summary(lane_fit(
+                *callables[name], candidates=LANE_FIT_CANDIDATES,
+                budget_bytes=budget_bytes, base_lanes=AUDIT_LANES,
+            ))
+            for name in names
+        }
+    return _REGISTRY_FIT_CACHE[key]
+
+
+def program_memory_accounting(
+    names: tuple[str, ...] | None = None,
+) -> dict[str, Any]:
+    """Backend-true accounting: AOT lower + compile every registry
+    program on the CURRENT backend and extract
+    `compiled.memory_analysis()` (argument/output/temp/generated-code
+    bytes). This is what chip-session stage 11 captures on the real
+    TPU; on CPU the numbers are real but post-folding (the broadcast
+    hazard is invisible here — that is the jaxpr rules' job). A
+    program that fails to compile records the error string instead of
+    killing the capture."""
+    import jax
+
+    out: dict[str, Any] = {"backend": jax.default_backend()}
+    for name, (fn, args) in program_callables(names).items():
+        mem = aot_memory(fn, *args)
+        if mem is None:
+            out[name] = {"error": "lower/compile/memory_analysis failed"}
+        else:
+            out[name] = mem
+    return out

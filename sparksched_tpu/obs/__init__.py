@@ -19,8 +19,14 @@ Three parts (ISSUE 2 tentpole), each usable on its own:
   jax.profiler.TraceAnnotation) around the GNN eval, the env
   micro-step, the collection scatter and the PPO update, so a captured
   Perfetto trace carries those phase labels.
+- `memory`: HBM byte accounting (ISSUE 5 tentpole) — compile-time
+  `memory_analysis()` extraction, trace-time buffer sizing under the
+  TPU tiled-layout model, the lane-fit advisor (max vmap lanes under
+  an HBM budget), and runtime `device_memory_stats()` for stamping
+  bench rows and trainer iterations.
 """
 
+from .memory import device_memory_stats, lane_fit  # noqa: F401
 from .runlog import RunLog, emit  # noqa: F401
 from .telemetry import Telemetry, summarize, telemetry_zeros  # noqa: F401
 from .tracing import annotate  # noqa: F401

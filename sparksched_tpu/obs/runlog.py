@@ -11,9 +11,18 @@ kind) and `t` (unix seconds); the kinds the trainer/bench write:
 - `scalars`: per-iteration training stats (the TensorBoard mirror —
   identical keys/values to what `add_scalar` receives)
 - `telemetry`: an engine-telemetry summary (`obs.telemetry.summarize`)
+- `memory`: a device-memory sample (`obs.memory.device_memory_stats`
+  fields — `bytes_in_use` / `peak_bytes_in_use` — plus the optional
+  `iteration`/`phase` the sample brackets)
 - `jit_compile` / `jit_compile_detail`: JIT (re)compilation events via
   `jax.monitoring` duration hooks plus the dispatch logger (the latter
   names WHICH function was traced/compiled)
+
+Crash-safety: every record is flushed at write time, and open runlogs
+are closed (a final `run_end` with a `teardown` reason) from an
+`atexit` hook and — when the process had no handler of its own — a
+chained SIGTERM handler, so a watcher-timeout-killed run keeps its
+partial telemetry instead of losing the tail.
 
 Readers: `PERF.md` "Reading a run" documents the schema; a runlog is
 greppable (`grep '"ev": "telemetry"' run.jsonl | tail -1`) and loads
@@ -22,10 +31,12 @@ with one `json.loads` per line.
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
 import os.path as osp
+import signal
 import sys
 import threading
 import time
@@ -85,6 +96,8 @@ class RunLog:
         self._lock = threading.Lock()
         self._fp = open(path, "a")
         self._closed = False
+        _OPEN_RUNLOGS.add(self)
+        _install_teardown_hooks()
 
     @classmethod
     def create(cls, artifacts_dir: str, name: str | None = None,
@@ -139,6 +152,18 @@ class RunLog:
             fields["iteration"] = int(iteration)
         self.write("telemetry", summary=summary, **fields)
 
+    def memory(self, stats: dict[str, Any],
+               iteration: int | None = None, phase: str | None = None,
+               **fields: Any) -> None:
+        """A device-memory sample (`obs.memory.device_memory_stats`
+        output); the allocator's keys land top-level so runlogs stay
+        greppable (`grep '"ev": "memory"'`)."""
+        if iteration is not None:
+            fields["iteration"] = int(iteration)
+        if phase is not None:
+            fields["phase"] = phase
+        self.write("memory", **(dict(stats or {}) | fields))
+
     # -- JIT recompile hooks ----------------------------------------------
 
     def install_jit_hooks(self) -> None:
@@ -156,6 +181,34 @@ class RunLog:
             self._closed = True
             self._fp.close()
         _ACTIVE_RUNLOGS.discard(self)
+        _OPEN_RUNLOGS.discard(self)
+
+    def _teardown(self, reason: str) -> None:
+        """Signal-context close: never blocks on the writer lock. A
+        SIGTERM handler runs on the main thread at the next bytecode
+        boundary — possibly INSIDE a write() still holding the
+        (non-reentrant) lock, mid-line; blocking would deadlock the
+        process, and writing anyway would interleave into a corrupt
+        line. If the lock is free, stamp run_end and close; otherwise
+        leave the file exactly as the per-write flushes left it (every
+        completed line already on disk, still parseable)."""
+        if self._closed or not self._lock.acquire(blocking=False):
+            return
+        try:
+            if self._closed:
+                return
+            try:
+                rec = {"ev": "run_end", "t": round(time.time(), 3),
+                       "teardown": reason}
+                self._fp.write(json.dumps(rec) + "\n")
+                self._fp.flush()
+            finally:
+                self._closed = True
+                self._fp.close()
+        finally:
+            self._lock.release()
+        _ACTIVE_RUNLOGS.discard(self)
+        _OPEN_RUNLOGS.discard(self)
 
     def __enter__(self) -> "RunLog":
         return self
@@ -181,6 +234,72 @@ class _Span:
         if exc_type is not None:
             fields["error"] = exc_type.__name__
         self._log.span_event(self._name, self.elapsed, **fields)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe teardown
+#
+# Watcher-killed runs (`timeout -k`, chip-window handovers) must keep
+# their partial telemetry. Records are already flushed per write, so
+# even SIGKILL loses at most nothing; the hooks below additionally
+# stamp a final `run_end` (with a `teardown` reason) on the exits a
+# process can still observe: interpreter shutdown (`atexit` — covers
+# normal exit, sys.exit and uncaught exceptions) and SIGTERM. The
+# SIGTERM handler is installed only when the process has none of its
+# own (SIG_DFL), runs only in the main thread, and re-raises the
+# default disposition afterwards so exit-status semantics (rc 143 /
+# `timeout` accounting) are unchanged.
+# ---------------------------------------------------------------------------
+
+_OPEN_RUNLOGS: "weakref.WeakSet[RunLog]" = weakref.WeakSet()
+_ATEXIT_INSTALLED = False
+_SIGTERM_INSTALLED = False
+
+
+def _close_open_runlogs(reason: str, from_signal: bool = False) -> None:
+    for rl in list(_OPEN_RUNLOGS):
+        try:
+            if from_signal:
+                rl._teardown(reason)  # must not block on the lock
+            else:
+                rl.close(teardown=reason)
+        except Exception:
+            pass  # teardown must never mask the original exit
+
+
+def _install_teardown_hooks() -> None:
+    global _ATEXIT_INSTALLED, _SIGTERM_INSTALLED
+    if not _ATEXIT_INSTALLED:
+        _ATEXIT_INSTALLED = True
+        atexit.register(_close_open_runlogs, "atexit")
+    if _SIGTERM_INSTALLED:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        # signal.signal is main-thread-only; leave the flag unset so a
+        # later RunLog created on the main thread still installs it
+        return
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+    except (ValueError, OSError):
+        return
+    if prev is not signal.SIG_DFL:
+        # the app owns SIGTERM (or a non-Python handler is active);
+        # atexit still covers clean exits — stop probing
+        _SIGTERM_INSTALLED = True
+        return
+
+    def _on_sigterm(signum, frame):
+        # restore the default disposition FIRST: if teardown ever
+        # wedges, a second SIGTERM must still kill the process
+        signal.signal(signum, signal.SIG_DFL)
+        _close_open_runlogs("sigterm", from_signal=True)
+        os.kill(os.getpid(), signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        _SIGTERM_INSTALLED = True
+    except (ValueError, OSError):
+        pass
 
 
 # ---------------------------------------------------------------------------

@@ -16,9 +16,19 @@ touches a thread-local name stack), so call sites don't have to care
 which side of the jit boundary they are on. The phases the codebase
 labels: `decima/gnn` (GNN eval), `env/micro_step` (flat engine),
 `collect/scatter` (decision-buffer scatter), `train/ppo_update`.
+
+Exception safety: a raise inside the annotated block (or inside one of
+the two underlying exits) must still pop the named-scope stack — a
+leaked scope prefixes every LATER trace's labels with a dead phase
+name, corrupting the whole capture, not just the failing region. Both
+context managers live on a `contextlib.ExitStack`, whose `__exit__`
+guarantees LIFO unwinding even when an inner exit raises;
+`tests/test_obs.py::test_annotate_exception_safe` pins it.
 """
 
 from __future__ import annotations
+
+import contextlib
 
 
 class annotate:
@@ -26,24 +36,22 @@ class annotate:
 
     def __init__(self, name: str) -> None:
         self.name = name
-        self._ns = None
-        self._ta = None
+        self._stack: contextlib.ExitStack | None = None
 
     def __enter__(self) -> "annotate":
         import jax
 
-        self._ns = jax.named_scope(self.name)
-        self._ns.__enter__()
+        stack = contextlib.ExitStack()
+        stack.enter_context(jax.named_scope(self.name))
         try:
-            self._ta = jax.profiler.TraceAnnotation(self.name)
-            self._ta.__enter__()
+            stack.enter_context(jax.profiler.TraceAnnotation(self.name))
         except Exception:
-            self._ta = None  # profiler backend unavailable: scope only
+            pass  # profiler backend unavailable: scope only
+        self._stack = stack
         return self
 
-    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
-        try:
-            if self._ta is not None:
-                self._ta.__exit__(exc_type, exc_val, exc_tb)
-        finally:
-            self._ns.__exit__(exc_type, exc_val, exc_tb)
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        stack, self._stack = self._stack, None
+        if stack is not None:
+            return stack.__exit__(exc_type, exc_val, exc_tb)
+        return False

@@ -44,6 +44,7 @@ from .. import metrics
 from ..config import EnvParams, env_params_from_cfg
 from ..env import core
 from ..obs import RunLog, emit
+from ..obs.memory import device_memory_stats
 from ..obs.telemetry import summarize, telemetry_zeros_like
 from ..schedulers import TrainableScheduler, make_scheduler
 from ..workload import make_workload_bank
@@ -182,6 +183,11 @@ class Trainer(abc.ABC):
         #     (the default sink; TensorBoard stays a mirror)
         #   telemetry: true — thread engine counters through the rollout
         #     collectors and summarize once per iteration
+        #   memory: true (default) — sample the device allocator
+        #     (`obs.memory.device_memory_stats`) once per iteration and
+        #     emit a `memory` runlog record + mem_* scalars; a no-op on
+        #     backends without allocator stats (CPU), so the default
+        #     costs nothing off-chip
         #   trace_iteration: N — capture a labeled jax.profiler device
         #     trace of (absolute) iteration N's collect+update
         #   trace_dir: where that trace lands (default
@@ -189,6 +195,7 @@ class Trainer(abc.ABC):
         oc = dict(obs_cfg or {})
         self.obs_runlog = oc.get("runlog", True)
         self.obs_telemetry: bool = bool(oc.get("telemetry", False))
+        self.obs_memory: bool = bool(oc.get("memory", True))
         ti = oc.get("trace_iteration")
         self.obs_trace_iteration = None if ti is None else int(ti)
         self.obs_trace_dir: str = oc.get(
@@ -609,6 +616,20 @@ class Trainer(abc.ABC):
                 host_stats["events_per_decision"] = tsum[
                     "events_per_decision"
                 ]
+            if self.obs_memory:
+                # one host call per iteration, after the update sync —
+                # outside the timed collect/update spans, so the sample
+                # reads the iteration's peak without riding its clock
+                mem = device_memory_stats()
+                if mem is not None:
+                    if self._runlog is not None:
+                        self._runlog.memory(mem, iteration=i)
+                    for src, dst in (
+                        ("bytes_in_use", "mem_bytes_in_use"),
+                        ("peak_bytes_in_use", "mem_peak_bytes"),
+                    ):
+                        if mem.get(src) is not None:
+                            host_stats[dst] = mem[src]
             self._write_stats(i, host_stats | roll_stats)
             emit(
                 f"Iteration {i + 1} complete. Avg. # jobs: "
@@ -665,6 +686,7 @@ class Trainer(abc.ABC):
                 rollout_steps=self.rollout_steps,
                 rollout_engine=self.rollout_engine,
                 telemetry=self.obs_telemetry,
+                memory=self.obs_memory,
                 seed=self.seed,
             )
         self._tb = None

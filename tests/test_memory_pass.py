@@ -1,0 +1,214 @@
+"""Memory pass (sparksched_tpu/analysis/memory + obs/memory): the
+tile-padded size model, seeded bank-broadcast fixtures (the rule must
+fire on a lane-batched bank producer and stay silent on the hoisted
+form), the bytes-budget regression path (CLI rc != 0 naming program +
+buffer), and the lane-fit advisor replaying the round-5 19.4 GB OOM
+without a chip."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bank():
+    from sparksched_tpu.analysis.jaxpr_audit import audit_setup
+
+    return audit_setup()[1]
+
+
+# ---------------------------------------------------------------------------
+# the tiled-layout size model
+# ---------------------------------------------------------------------------
+
+
+def test_aval_bytes_tile_padding():
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.obs.memory import aval_bytes
+
+    a = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    assert aval_bytes(a, tile_pad=False) == 8 * 16 * 4
+    # minor dim lane-padded 16 -> 128; second-minor 8 is already a
+    # full f32 sublane (32 bytes / 4)
+    assert aval_bytes(a) == 8 * 128 * 4
+    # the round-5 temp: f32[512,154,20,3,8,16] = 2.4 GB dense but
+    # 19.4 GB tile-padded — the 8x minor-dim inflation that put it
+    # over the 17.2 GB part
+    big = jax.ShapeDtypeStruct((512, 154, 20, 3, 8, 16), jnp.float32)
+    assert round(aval_bytes(big, tile_pad=False) / 1e9, 1) == 2.4
+    assert round(aval_bytes(big) / 1e9, 1) == 19.4
+
+
+# ---------------------------------------------------------------------------
+# bank-broadcast rule: seeded violation + hoisted-form negative
+# ---------------------------------------------------------------------------
+
+
+def _lane_pred_struct():
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct((), jnp.float32)
+
+
+def test_bank_broadcast_fires_on_lane_batched_producer(bank):
+    import jax.numpy as jnp
+    from jax import lax
+
+    from sparksched_tpu.analysis.memory import check_bank_broadcast
+    from sparksched_tpu.obs.memory import _trace_vmapped
+
+    def bad(x):
+        # the pre-81e77fb pattern: a bank table inside a lane-dependent
+        # branch. cond's batching rule broadcasts the operands when the
+        # predicate is lane-dependent, so the vmapped jaxpr contains a
+        # per-lane copy of the dur table.
+        return lax.cond(
+            x > 0, lambda: bank.dur, lambda: jnp.zeros_like(bank.dur)
+        ).sum()
+
+    closed = _trace_vmapped(bad, (_lane_pred_struct(),), 4)
+    vs = check_bank_broadcast("fixture", closed, bank, 4)
+    assert vs, "the seeded lane-batched dur producer did not fire"
+    assert all(v.rule == "bank-broadcast" for v in vs)
+    # the report names the table and the hoist remedy, not a bare shape
+    assert any("dur" in v.detail for v in vs)
+    assert any("hoist" in v.detail for v in vs)
+
+
+def test_bank_broadcast_clears_on_hoisted_form(bank):
+    from jax import lax
+
+    from sparksched_tpu.analysis.memory import check_bank_broadcast
+    from sparksched_tpu.obs.memory import _trace_vmapped
+
+    def good(x):
+        # the 81e77fb fix pattern: the bank access is hoisted out of
+        # the lane-dependent branch; the cond only carries scalars
+        d = bank.dur.sum()
+        return lax.cond(x > 0, lambda: d, lambda: d * 0.0)
+
+    closed = _trace_vmapped(good, (_lane_pred_struct(),), 4)
+    assert check_bank_broadcast("fixture", closed, bank, 4) == []
+
+
+# ---------------------------------------------------------------------------
+# bytes budget: regression fixture through the real CLI entry point
+# ---------------------------------------------------------------------------
+
+
+def test_mem_budget_breach_fails_with_named_buffer(monkeypatch, capsys):
+    from sparksched_tpu.analysis import memory
+    from sparksched_tpu.analysis.__main__ import main
+
+    monkeypatch.setitem(
+        memory.MEM_BUDGETS, "observe", memory.MemBudget(temp_hi=1)
+    )
+    rc = main(["--passes", "memory", "--programs", "observe"])
+    assert rc != 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["clean"] is False
+    v = report["violations"][0]
+    assert v["rule"] == "mem-budget" and v["where"] == "observe"
+    # the report names the dominant buffer (op + shape), not a bare
+    # byte count — the attribution requirement of the tentpole
+    assert "largest buffer" in v["detail"]
+
+
+def test_unknown_program_name_is_an_error():
+    from sparksched_tpu.analysis.memory import audit_memory
+
+    with pytest.raises(ValueError, match="not_a_program"):
+        audit_memory(names=("not_a_program",))
+
+
+def test_memory_pass_reports_accounting_and_lane_fit():
+    from sparksched_tpu.analysis.memory import audit_memory
+
+    vs, measured = audit_memory(names=("observe",))
+    assert vs == []
+    m = measured["observe"]
+    for key in ("temp_total_bytes", "args_bytes", "out_bytes",
+                "peak_lower_bound_bytes", "largest"):
+        assert key in m
+    assert m["largest"] and {"bytes", "shape", "op"} <= set(
+        m["largest"][0]
+    )
+    # observe is a lane program: the advisor must report its fit, and
+    # the tiny per-lane observation comfortably fits the full 1024-lane
+    # production width under the default budget
+    assert m["lane_fit"]["max_lanes_fit"] >= 1024
+
+
+# ---------------------------------------------------------------------------
+# lane-fit advisor: the round-5 incident, replayed on CPU
+# ---------------------------------------------------------------------------
+
+
+def test_lane_fit_replays_round5_oom(bank):
+    import jax.numpy as jnp
+    from jax import lax
+
+    from sparksched_tpu.obs.memory import TPU_HBM_BUDGET_BYTES, lane_fit
+
+    # the audit bank's dur table IS the incident table's shape
+    assert tuple(bank.dur.shape) == (154, 20, 3, 8, 16)
+
+    def pre_fix(x):
+        # pre-81e77fb: _bulk_fulfill's dur gather inside the
+        # lane-dependent decide branch
+        return lax.cond(
+            x > 0, lambda: bank.dur, lambda: jnp.zeros_like(bank.dur)
+        ).sum()
+
+    fit = lane_fit(
+        pre_fix, (_lane_pred_struct(),), candidates=(64, 512, 1024),
+        budget_bytes=TPU_HBM_BUDGET_BYTES,
+    )
+    by_lanes = {c["lanes"]: c for c in fit["candidates"]}
+    # the regression the chip found: 512 lanes do NOT fit 17.2 GB
+    assert not by_lanes[512]["fits"]
+    assert fit["max_lanes_fit"] < 512
+    # and the report names the offending table at its headline size:
+    # the dominant buffer is the six-dim per-lane dur copy, 19.4 GB
+    # tile-padded at 512 lanes (so est_peak is at least that)
+    assert by_lanes[512]["est_peak_bytes"] >= 19.3e9
+    top = by_lanes[512]["top"]
+    assert "154,20,3,8,16" in top["shape"]
+
+    def post_fix(x):
+        # hoisted: the gather happens once, outside the branch
+        d = bank.dur.sum()
+        return lax.cond(x > 0, lambda: d, lambda: d * 0.0)
+
+    fit2 = lane_fit(
+        post_fix, (_lane_pred_struct(),), candidates=(512, 1024),
+        budget_bytes=TPU_HBM_BUDGET_BYTES,
+    )
+    assert fit2["max_lanes_fit"] >= 1024
+
+
+def test_lane_fit_linear_model_matches_direct_trace(bank):
+    """The two-point linear model must agree with a direct trace at an
+    off-base lane count (vmap batching is linear in lanes, so the fit
+    is exact — a mismatch means the model mis-reads the jaxpr)."""
+    import jax.numpy as jnp
+
+    from sparksched_tpu.obs.memory import (
+        _trace_vmapped,
+        jaxpr_memory_estimate,
+        lane_fit,
+    )
+
+    def fn(x):
+        return (x * 2.0 + jnp.float32(1.0)).sum()
+
+    args = (jnp.zeros((8, 16), jnp.float32),)
+    fit = lane_fit(fn, args, candidates=(64,))
+    direct = jaxpr_memory_estimate(_trace_vmapped(fn, args, 64))
+    est = fit["candidates"][0]["est_peak_bytes"]
+    assert est == direct["peak_lower_bound_bytes"]

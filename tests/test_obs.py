@@ -1,5 +1,6 @@
-"""Observability subsystem (sparksched_tpu/obs): runlog JSONL schema,
-telemetry summaries, profiler trace hygiene, and the TensorBoard
+"""Observability subsystem (sparksched_tpu/obs): runlog JSONL schema
+(incl. the `memory` records and crash-safe teardown), telemetry
+summaries, trace-annotation and profiler hygiene, and the TensorBoard
 fallback. (The no-bare-print lint that used to live here is now the
 analyzer's `bare-print` rule — sparksched_tpu/analysis/lint.py, run by
 tests/test_static_analysis.py.)"""
@@ -7,7 +8,11 @@ tests/test_static_analysis.py.)"""
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
 import sys
+import textwrap
 
 import numpy as np
 import pytest
@@ -94,6 +99,42 @@ def test_profiler_stops_trace_on_exception(tmp_path):
     # "Only one profile may be run at a time" if __exit__ leaked it
     jax.profiler.start_trace(str(tmp_path / "t2"))
     jax.profiler.stop_trace()
+
+
+def test_annotate_exception_safe():
+    """A raise inside an annotated region must pop the named-scope
+    stack — a leaked scope would prefix every LATER trace's labels with
+    the dead phase name (the corruption the ISSUE-5 satellite pins)."""
+    import jax
+
+    from jax._src import source_info_util
+
+    from sparksched_tpu.obs import annotate
+
+    def stack() -> str:
+        return str(source_info_util.current_name_stack())
+
+    assert stack() == ""
+    with annotate("live"):
+        assert "live" in stack()
+    assert stack() == ""
+    with pytest.raises(RuntimeError, match="boom"):
+        with annotate("poisoned"):
+            assert "poisoned" in stack()
+            raise RuntimeError("boom")
+    assert stack() == "", "exception exit leaked the trace scope"
+    # and nested: an inner raise unwinds exactly the inner scope
+    with pytest.raises(ValueError):
+        with annotate("outer"):
+            try:
+                with annotate("inner"):
+                    raise ValueError("x")
+            finally:
+                assert "inner" not in stack() and "outer" in stack()
+    assert stack() == ""
+    # the annotation still functions after all that (tracing sanity)
+    with annotate("alive"):
+        jax.make_jaxpr(lambda x: x + 1)(1.0)
 
 
 def test_profiler_sink_receives_span_even_when_quiet():
@@ -190,6 +231,122 @@ def test_runlog_span_and_json_safety(tmp_path):
 # CI smoke (satellite): one tiny training iteration with obs: enabled
 # produces a valid-JSONL runlog with the expected span/counter keys
 # ---------------------------------------------------------------------------
+
+
+def test_runlog_memory_record_schema(tmp_path):
+    from sparksched_tpu.obs import RunLog
+
+    rl = RunLog(str(tmp_path / "m.jsonl"))
+    rl.memory({"bytes_in_use": 111, "peak_bytes_in_use": 222},
+              iteration=3)
+    rl.memory(None, phase="bench_warmup")  # stats-less backends: no-op keys
+    rl.close()
+    recs = [json.loads(ln) for ln in open(rl.path)]
+    mems = [r for r in recs if r["ev"] == "memory"]
+    assert mems[0]["bytes_in_use"] == 111
+    assert mems[0]["peak_bytes_in_use"] == 222
+    assert mems[0]["iteration"] == 3
+    assert mems[1]["phase"] == "bench_warmup"
+
+
+# ---------------------------------------------------------------------------
+# crash-safety (satellite): a watcher-killed run must leave a parseable
+# runlog with its partial telemetry — SIGTERM lands a final run_end via
+# the teardown hook; even without it, per-write flushing means every
+# completed record survives
+# ---------------------------------------------------------------------------
+
+_KILLED_RUN = textwrap.dedent("""\
+    import sys, time
+    from sparksched_tpu.obs import RunLog
+
+    rl = RunLog(sys.argv[1])
+    rl.write("run_start", demo="kill")
+    for i in range(10_000):
+        rl.write("tick", i=i)
+        if i == 3:
+            print("READY", flush=True)
+        time.sleep(0.05)
+""")
+
+
+def test_sigterm_killed_run_leaves_parseable_runlog(tmp_path):
+    path = str(tmp_path / "killed.jsonl")
+    env = os.environ | {"JAX_PLATFORMS": "cpu"}
+    import pathlib
+
+    p = subprocess.Popen(
+        [sys.executable, "-c", _KILLED_RUN, path],
+        env=env, stdout=subprocess.PIPE, text=True,
+        cwd=pathlib.Path(__file__).resolve().parent.parent,
+    )
+    try:
+        assert p.stdout.readline().strip() == "READY"
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=60)
+    finally:
+        p.kill()
+    # the teardown hook restores the default disposition and re-raises,
+    # so the exit status still says "killed by SIGTERM"
+    assert rc == -signal.SIGTERM
+    recs = [json.loads(ln) for ln in open(path)]  # every line parses
+    assert recs[0]["ev"] == "run_start"
+    assert any(r["ev"] == "tick" for r in recs)
+    assert recs[-1]["ev"] == "run_end"
+    assert recs[-1]["teardown"] == "sigterm"
+
+
+def test_sigterm_teardown_never_blocks_on_held_lock(tmp_path):
+    """The signal-path close must not block on the writer lock: a
+    SIGTERM handler runs on the main thread possibly INSIDE a write()
+    that holds the (non-reentrant) lock mid-line — blocking would
+    deadlock the process, writing anyway would corrupt the line. With
+    the lock held, _teardown must return immediately and leave the log
+    open; with it free, it stamps run_end."""
+    from sparksched_tpu.obs import RunLog
+
+    rl = RunLog(str(tmp_path / "h.jsonl"))
+    rl.write("tick", i=0)
+    assert rl._lock.acquire(blocking=False)  # simulate interrupted write
+    try:
+        rl._teardown("sigterm")  # must return, not deadlock
+        assert not rl._closed
+    finally:
+        rl._lock.release()
+    rl._teardown("sigterm")  # lock free: closes with the stamp
+    assert rl._closed
+    recs = [json.loads(ln) for ln in open(rl.path)]
+    assert recs[-1] == recs[-1] | {"ev": "run_end",
+                                   "teardown": "sigterm"}
+
+
+def test_trainer_stamps_memory_records(tmp_path, monkeypatch):
+    """The trainer's per-iteration memory sample: `memory` runlog
+    records + mem_* scalars, via the obs: block default. The allocator
+    probe is monkeypatched — CPU backends report no stats, and the
+    wiring (not the backend) is what this pins."""
+    import sparksched_tpu.trainers.trainer as trainer_mod
+
+    from sparksched_tpu.trainers import make_trainer
+
+    monkeypatch.setattr(
+        trainer_mod, "device_memory_stats",
+        lambda device=None: {"bytes_in_use": 111,
+                             "peak_bytes_in_use": 222},
+    )
+    cfg = _tiny_cfg(tmp_path)
+    t = make_trainer(cfg)
+    t.train()
+    runlogs = list((tmp_path / "runlog").glob("*.jsonl"))
+    recs = [json.loads(ln) for ln in open(runlogs[0])]
+    start = [r for r in recs if r["ev"] == "run_start"][0]
+    assert start["memory"] is True
+    mems = [r for r in recs if r["ev"] == "memory"]
+    assert mems and mems[-1]["peak_bytes_in_use"] == 222
+    assert "iteration" in mems[-1]
+    sc = [r for r in recs if r["ev"] == "scalars"][-1]
+    assert sc["mem_peak_bytes"] == 222
+    assert sc["mem_bytes_in_use"] == 111
 
 
 def test_training_iteration_writes_runlog(tmp_path):

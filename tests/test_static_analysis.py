@@ -21,20 +21,31 @@ import pytest
 
 
 def test_shipped_tree_is_analysis_clean():
-    from sparksched_tpu.analysis import run_all
+    from sparksched_tpu.analysis import DEFAULT_PASSES, run_all
+    from sparksched_tpu.analysis.jaxpr_audit import LANE_PROGRAMS
 
-    report = run_all(("lint", "contracts", "jaxpr"))
+    report = run_all(DEFAULT_PASSES)
     assert report["clean"], "\n".join(
         f"[{v['passname']}/{v['rule']}] {v['where']}: {v['detail']}"
         for v in report["violations"]
     )
-    # >= 8 rules across three passes is the subsystem's acceptance bar;
-    # the registry traced every hot program
-    assert set(report["passes"]["jaxpr"]["measured"]) == {
+    # >= 8 rules across the passes is the subsystem's acceptance bar;
+    # the registry traced every hot program — in BOTH registry passes
+    # (the memory pass shares the unbatched traces via the cache, so
+    # the two can never audit different programs under one name)
+    all_programs = {
         "observe", "micro_step", "decide_micro_step",
         "drain_to_decision", "decima_score", "decima_batch_policy",
         "ppo_update",
     }
+    assert set(report["passes"]["jaxpr"]["measured"]) == all_programs
+    mem = report["passes"]["memory"]["measured"]
+    assert set(mem) == all_programs
+    # every lane program carries a lane-fit verdict, and the shipped
+    # (post-81e77fb) engine fits the full 1024-lane production width
+    # under the default 17.2 GB budget
+    for name in LANE_PROGRAMS:
+        assert mem[name]["lane_fit"]["max_lanes_fit"] >= 1024, name
 
 
 def test_cli_json_and_exit_code():
