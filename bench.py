@@ -56,6 +56,32 @@ import os
 # lane count; overridable for off-chip smoke runs (the headline metric
 # is only comparable at the default 1024)
 NUM_ENVS = int(os.environ.get("BENCH_NUM_ENVS", 1024))
+
+
+def _parse_mesh_dp() -> int:
+    """`--mesh-dp N` CLI flag (wins) or BENCH_MESH_DP env var; 0 = no
+    mesh (the single-device bench). dp=1 normalizes to 0 — the
+    unsharded bench IS the 1-device configuration (mesh_from_config
+    has the same contract), and mesh-only code paths (single-pass
+    SUB_BATCH, the `_dpN` metric) must not trigger without sharding."""
+    v = int(os.environ.get("BENCH_MESH_DP", "0") or 0)
+    if "--mesh-dp" in sys.argv:
+        i = sys.argv.index("--mesh-dp")
+        try:
+            v = int(sys.argv[i + 1])
+        except (IndexError, ValueError):
+            sys.exit("bench.py: --mesh-dp needs an integer argument")
+    return 0 if v <= 1 else v
+
+
+# dp-mesh scale-out (ISSUE 6): shard the lane axis over a 1-D dp mesh
+# (parallel.py) and emit a row tagged `dp` with per-device lanes and
+# per-device dec/s alongside the aggregate. `--mesh-dp N` needs N
+# visible devices — real chips, or (BENCH_VIRTUAL_MESH=1, CI) a
+# virtual N-device CPU backend the __main__ block bootstraps. Mesh
+# rows are a separate metric name (`..._dpN`): sharded numbers must
+# never masquerade as the single-chip headline.
+MESH_DP = _parse_mesh_dp()
 # the tunneled v5e faults on >=1024-lane vmaps of the full step (kernel
 # fault at exactly the 8x128 tile boundary); process lanes in sub-batches
 # of 512 via lax.map inside one jit — same program, bounded vector width.
@@ -169,7 +195,8 @@ def _fit_lane_args(params, bank):
     return (jax.eval_shape(init_loop_state, state), key)
 
 
-def _memory_stamp(params, bank, bulk_events, fulfill_bulk, bulk_cycles):
+def _memory_stamp(params, bank, bulk_events, fulfill_bulk, bulk_cycles,
+                  mesh=None):
     if not MEMFIT:
         return memory_row_stamp()
     return memory_row_stamp(
@@ -178,11 +205,14 @@ def _memory_stamp(params, bank, bulk_events, fulfill_bulk, bulk_cycles):
         ),
         _fit_lane_args(params, bank),
         candidates=tuple(sorted({SUB_BATCH, NUM_ENVS, 1024})),
+        # dp mesh: candidates are global lane counts, the fit is per
+        # SHARD against the per-chip budget (obs/memory.py lane_fit)
+        mesh=mesh,
     )
 
 
 def _predict_skip_cause(params, bank, bulk_events, fulfill_bulk,
-                        bulk_cycles) -> str | None:
+                        bulk_cycles, mesh=None) -> str | None:
     """The memory pass's verdict on a failed calibration candidate: is
     this the single-buffer HBM blowup class (the round-5 19.4 GB OOM)
     at this sub-batch width, and which buffer dominates. Best-effort —
@@ -196,6 +226,7 @@ def _predict_skip_cause(params, bank, bulk_events, fulfill_bulk,
             ),
             _fit_lane_args(params, bank),
             candidates=(SUB_BATCH,),
+            mesh=mesh,
         )
         c = fit["candidates"][0]
         top = c.get("top", {})
@@ -309,10 +340,37 @@ def main() -> None:
             max_stages=bank.max_stages, max_levels=bank.max_stages
         )
 
+    global SUB_BATCH
+
+    # --- dp mesh (ISSUE 6): lane axis sharded over the devices ---------
+    mesh = None
+    if MESH_DP:
+        from sparksched_tpu.parallel import make_mesh, shard_lanes
+
+        assert NUM_ENVS % MESH_DP == 0, (
+            f"BENCH_MESH_DP={MESH_DP} must divide {NUM_ENVS}"
+        )
+        mesh = make_mesh(MESH_DP)
+        # single pass over the full lane stack: the lax.map sub-batch
+        # reshape would fold the sharded lane axis into a leading trip
+        # dimension and force resharding every map step (the sub-batch
+        # fault workaround is a single-chip concern; per-device width
+        # here is NUM_ENVS/dp, already below the fault boundary for
+        # dp >= 2 at the headline 1024)
+        SUB_BATCH = NUM_ENVS
+
+    def shard(tree):
+        return shard_lanes(tree, mesh) if mesh is not None else tree
+
+    def lane_keys(seed: int):
+        return shard(
+            jax.random.split(jax.random.PRNGKey(seed), NUM_ENVS)
+        )
+
     rng = jax.random.PRNGKey(0)
     reset_keys = jax.random.split(rng, NUM_ENVS)
     states = jax.vmap(lambda k: core.reset(params, bank, k))(reset_keys)
-    loop_states = jax.vmap(init_loop_state)(states)
+    loop_states = shard(jax.vmap(init_loop_state)(states))
 
     # --- sub-batch resolution (round-8 headroom retry) -----------------
     # With BENCH_SUB_BATCH unset and an accelerator answering, try the
@@ -323,10 +381,10 @@ def main() -> None:
     # (config.sub_batch) and the retry outcome. CPU never probes — the
     # fault being retried is accelerator-specific and the fallback's
     # <=256 clamp is cache-friendliness, not fault avoidance.
-    global SUB_BATCH
     sub_batch_retry = None
     if (
         _SB_ENV is None
+        and not MESH_DP  # mesh runs are single-pass already
         and not CPU_FALLBACK
         and jax.default_backend() != "cpu"
         and NUM_ENVS >= 1024
@@ -334,8 +392,7 @@ def main() -> None:
     ):
         try:
             _, _, n = bench_chunk(
-                params, bank, loop_states,
-                jax.random.split(jax.random.PRNGKey(50), NUM_ENVS),
+                params, bank, loop_states, lane_keys(50),
                 8, True, 1, None, sub_batch=1024,
             )
             jax.block_until_ready(n)
@@ -387,12 +444,15 @@ def main() -> None:
                 cands += [(b, fb, bc) for b in _BE_CANDS]
             cands += [(0, fb, bc)]
         cands = list(dict.fromkeys(cands))
-    telem = telemetry_zeros_like((NUM_ENVS,)) if TELEMETRY else None
+    telem = (
+        shard(telemetry_zeros_like((NUM_ENVS,)))
+        if TELEMETRY else None
+    )
 
     skipped_candidates: list[dict] = []
 
     def warm_candidates(cands, loop_states, telem):
-        keys = jax.random.split(jax.random.PRNGKey(1), NUM_ENVS)
+        keys = lane_keys(1)
         ok = []
         for i, (be, fb, bc) in enumerate(cands):
             try:
@@ -405,7 +465,9 @@ def main() -> None:
                 # not a bare skip: ask the memory pass whether this is
                 # the HBM-blowup failure class and which buffer — the
                 # round-5 OOM's postmortem, available at skip time
-                cause = _predict_skip_cause(params, bank, be, fb, bc)
+                cause = _predict_skip_cause(
+                    params, bank, be, fb, bc, mesh=mesh
+                )
                 print(
                     f"# bench: candidate bulk_events={be} "
                     f"fulfill_bulk={fb} bulk_cycles={bc} skipped at "
@@ -424,7 +486,7 @@ def main() -> None:
                 loop_states = ls_try
                 telem = tm_try
                 ok.append((be, fb, bc))
-            keys = jax.random.split(jax.random.PRNGKey(90 + i), NUM_ENVS)
+            keys = lane_keys(90 + i)
         return ok, loop_states, telem
 
     ok_cands, loop_states, telem = warm_candidates(
@@ -455,11 +517,10 @@ def main() -> None:
             # re-seed finished lanes before each candidate so all
             # measure the same live-lane precondition
             loop_states = reset_done_lanes(
-                params, bank, loop_states,
-                jax.random.split(jax.random.PRNGKey(80 + i), NUM_ENVS),
+                params, bank, loop_states, lane_keys(80 + i),
             )
             d0 = int(jax.block_until_ready(loop_states.decisions.sum()))
-            kk = jax.random.split(jax.random.PRNGKey(70 + i), NUM_ENVS)
+            kk = lane_keys(70 + i)
             tc = time.perf_counter()
             loop_states, telem, n = bench_chunk(
                 params, bank, loop_states, kk, be, fb, bc, telem,
@@ -478,8 +539,7 @@ def main() -> None:
     # timed run starts from a freshly re-seeded lane population on both
     # the calibrated and the env-pinned paths
     loop_states = reset_done_lanes(
-        params, bank, loop_states,
-        jax.random.split(jax.random.PRNGKey(101), NUM_ENVS),
+        params, bank, loop_states, lane_keys(101),
     )
     base = int(jax.block_until_ready(loop_states.decisions.sum()))
     # telemetry snapshot: the emitted summary covers the timed window
@@ -488,14 +548,13 @@ def main() -> None:
 
     t0 = time.perf_counter()
     for i in range(NUM_CHUNKS):
-        keys = jax.random.split(jax.random.PRNGKey(2 + i), NUM_ENVS)
+        keys = lane_keys(2 + i)
         loop_states, telem, n = bench_chunk(
             params, bank, loop_states, keys, bulk_events, fulfill_bulk,
             bulk_cycles, telem, sub_batch=SUB_BATCH,
         )
         loop_states = reset_done_lanes(
-            params, bank, loop_states,
-            jax.random.split(jax.random.PRNGKey(102 + i), NUM_ENVS),
+            params, bank, loop_states, lane_keys(102 + i),
         )
         total = int(jax.block_until_ready(n))
     dt = time.perf_counter() - t0
@@ -509,7 +568,9 @@ def main() -> None:
     row = {
         "metric": (
             f"env_decision_steps_per_sec_{NUM_ENVS}envs_fair_"
-            "synthetic_tpch" + _metric_suffix()
+            "synthetic_tpch"
+            + (f"_dp{MESH_DP}" if MESH_DP else "")
+            + _metric_suffix()
         ),
         "value": round(value, 1),
         "unit": "steps/s",
@@ -536,6 +597,17 @@ def main() -> None:
             "telemetry": TELEMETRY,
         },
     }
+    if MESH_DP:
+        # the sharded row's own vocabulary: aggregate dec/s is `value`;
+        # per-device dec/s and lanes make the row a scaling datum on
+        # its own (MULTICHIP_r*.json carries these rows verbatim)
+        row["config"]["dp"] = MESH_DP
+        row["config"]["lanes_per_device"] = NUM_ENVS // MESH_DP
+        row["per_device"] = {
+            "dp": MESH_DP,
+            "lanes": NUM_ENVS // MESH_DP,
+            "steps_per_sec": round(value / MESH_DP, 1),
+        }
     if skipped_candidates:
         # a row whose calibration silently dropped candidates is not
         # comparable with one that tried them all — the skip list (with
@@ -545,7 +617,7 @@ def main() -> None:
     # timed program at the calibrated knobs; computed AFTER the timed
     # window (the two small traces must not ride the measured chunks)
     row["memory"] = _memory_stamp(
-        params, bank, bulk_events, fulfill_bulk, bulk_cycles
+        params, bank, bulk_events, fulfill_bulk, bulk_cycles, mesh=mesh
     )
     if TELEMETRY:
         # micro-step composition + straggler ratio over the timed
@@ -671,6 +743,15 @@ if __name__ == "__main__":
         use_fast_prng,
     )
 
+    if MESH_DP > 1 and os.environ.get("BENCH_VIRTUAL_MESH") == "1":
+        # CI / single-chip hosts: bootstrap a virtual MESH_DP-device
+        # CPU backend (the same in-process flip tests/conftest.py
+        # uses) so the sharded row is measurable without hardware —
+        # the row stays honestly labeled via config.backend and the
+        # _cpu metric suffix
+        from __graft_entry__ import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(MESH_DP)
     honor_jax_platforms_env()
     enable_compilation_cache()
     if os.environ.get("BENCH_PRNG", "rbg") == "rbg":

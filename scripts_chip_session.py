@@ -28,6 +28,13 @@ Stages (each guarded; a failure logs and moves on):
      compiled.memory_analysis() (argument/output/temp bytes — the
      numbers XLA:CPU folds away) plus device memory_stats(), into
      artifacts/memory_chip.json. Claims the device client.
+  12. sharded multichip bench (ISSUE 6): the headline bench with the
+     lane axis sharded over every visible device (bench.py
+     --mesh-dp). Gated on len(jax.devices()) > 1 INSIDE a subprocess
+     (counting devices claims the client); a single-chip host records
+     an explicit UNAVAILABLE marker — absence of a dp row must read
+     as "no multi-chip window", never as "stage didn't run". Like
+     stage 7, run it as its own invocation.
 
 Every bench row (stages 3/4/8) is stamped with the on-device telemetry
 summary — micro-step composition, straggler ratio, events/decision —
@@ -367,6 +374,64 @@ def stage_memory_capture():
     )
 
 
+def stage_multichip_bench():
+    """Sharded bench capture (ISSUE 6): bench.py with the lane axis
+    sharded over every visible device — the real-mesh rows for
+    MULTICHIP_r*.json when a multi-chip window opens. Runs ENTIRELY in
+    a subprocess, gate included: counting devices claims the client,
+    so the parent must never peek first. A single-device host exits 0
+    with an explicit `[multichip] UNAVAILABLE` marker (the watcher log
+    must distinguish "no window" from "never ran"); >= 2 devices sets
+    BENCH_MESH_DP to the device count and runs the standard bench
+    main, whose row lands tagged dp/per_device like the virtual-mesh
+    CI rows."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    if _client_held():
+        print("[multichip] parent process already holds a device "
+              "client; run stage 12 as its own invocation", flush=True)
+        return
+    repo = osp.dirname(osp.abspath(__file__))
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from sparksched_tpu.config import (\n"
+        "    enable_compilation_cache, honor_jax_platforms_env,\n"
+        "    use_fast_prng,\n"
+        ")\n"
+        "honor_jax_platforms_env()\n"
+        "enable_compilation_cache()\n"
+        "if os.environ.get('BENCH_PRNG', 'rbg') == 'rbg':\n"
+        "    use_fast_prng()\n"
+        "import jax\n"
+        "n = len(jax.devices())\n"
+        "if n <= 1:\n"
+        "    print('[multichip] UNAVAILABLE: %d visible device(s) on "
+        "%s backend; the sharded bench needs a multi-chip window "
+        "(virtual-mesh CPU rows are the CI stand-in, see "
+        "MULTICHIP_r06.json)' % (n, jax.default_backend()), "
+        "flush=True)\n"
+        "    sys.exit(0)\n"
+        "envs = int(os.environ.get('BENCH_NUM_ENVS', 1024))\n"
+        "dp = next(d for d in range(n, 0, -1) if envs % d == 0)\n"
+        "if dp != n:\n"
+        "    print('[multichip] clamping dp %d -> %d (largest divisor "
+        "of %d lanes; bench.py asserts divisibility)' % (n, dp, envs), "
+        "flush=True)\n"
+        "os.environ['BENCH_MESH_DP'] = str(dp)\n"
+        "import bench\n"
+        "bench.main()\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, timeout=3600,
+        env=os.environ | {"BENCH_CPU_FALLBACK": "0"},
+    )
+    print(f"[multichip] subprocess rc={r.returncode}", flush=True)
+
+
 STAGES = {
     "1": ("sanity", stage_sanity),
     "2": ("burst sweep", stage_sweep),
@@ -379,6 +444,7 @@ STAGES = {
     "9": ("labeled device trace", stage_obs_trace),
     "10": ("static-analysis gate", stage_analysis),
     "11": ("on-chip memory capture", stage_memory_capture),
+    "12": ("sharded multichip bench", stage_multichip_bench),
 }
 
 
@@ -395,7 +461,8 @@ if __name__ == "__main__":
                 print("chip unavailable; aborting session", flush=True)
                 break
         finally:
-            # 7 runs in a subprocess and 10 is CPU-subprocess-only:
-            # neither takes the in-process device client
-            if p not in ("7", "10"):
+            # 7 and 12 run in subprocesses and 10 is
+            # CPU-subprocess-only: none takes the in-process device
+            # client
+            if p not in ("7", "10", "12"):
                 _mark_client_held()

@@ -27,6 +27,8 @@ rm -f /tmp/stop_chip_watch  # consume any stale stop request at launch
 rm -f /tmp/headline_r05_remeasured
 # same per-lifetime semantics for the on-chip memory capture (stage 11)
 rm -f /tmp/memcap_done
+# ... and for the sharded multichip bench (stage 12, ISSUE 6)
+rm -f /tmp/multichip_done
 # one-time legacy sweep: earlier-round trainers (tracked only by name,
 # pre-PID-file) must not survive into this watcher's lifetime — they
 # would contend the single core untracked and never be stopped for
@@ -157,6 +159,28 @@ print('ALIVE')
       echo "memcap rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
       grep -q "wrote artifacts/memory_chip.json" /tmp/memcap_last.log \
         && touch "$MEMCAP_MARK"
+    fi
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time sharded multichip bench (ISSUE 6, stage 12): bench.py
+    # with the lane axis sharded over every visible device. The gate
+    # lives INSIDE the stage's subprocess (counting devices claims the
+    # client); on today's single-chip tunnel it logs an explicit
+    # "[multichip] UNAVAILABLE" marker and exits 0 — that marker (not
+    # silence) is what tells the round reader no multi-chip window
+    # opened. Marked done on EITHER outcome: a recorded UNAVAILABLE is
+    # this lifetime's answer, and re-probing each window would burn
+    # bench-sized time against an unchanged device count.
+    MULTICHIP_MARK=/tmp/multichip_done
+    if [ ! -f "$MULTICHIP_MARK" ]; then
+      timeout -k 60 3600 python scripts_chip_session.py 12 \
+        | tee /tmp/multichip_last.log
+      echo "multichip rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      # done on ANY completed attempt — UNAVAILABLE, success, or
+      # failure: the device count won't change within this lifetime,
+      # so a deterministic failure would otherwise re-burn up to an
+      # hour per loop and starve the flagship training stage below
+      # (the log keeps the failing output for the round reader)
+      touch "$MULTICHIP_MARK"
     fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable

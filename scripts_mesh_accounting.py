@@ -2,7 +2,9 @@
 
 One real chip is available, so wall-clock scaling cannot be measured;
 what CAN be measured without hardware is how the compiled SPMD programs
-partition work. For dp in {1, 2, 4, 8} this script compiles the PPO
+partition work. For each engine (`core` = per-decision scan, `flat` =
+the single-eval micro-step collector — the production path ISSUE 6
+ships sharded) and dp in {1, 2, 4, 8} this script compiles the PPO
 collect and update at fixed GLOBAL batch (lanes sharded over the mesh,
 params replicated — parallel.py) and records, per program:
 
@@ -11,15 +13,17 @@ params replicated — parallel.py) and records, per program:
 - XLA cost_analysis FLOPs — for an SPMD program this is per-device work,
   so near-1/dp scaling is the scaling claim made concrete,
 - the collective ops in the optimized HLO of the update (all-reduce for
-  gradient/advantage reductions, all-gather for the global minibatch
-  permutation) and their count — the ICI/DCN traffic the design pays.
+  gradient/advantage reductions and their re-associations) and their
+  count — the ICI/DCN traffic the design pays. The census helpers live
+  in parallel.py and are shared with tests/test_parallel.py's census
+  test, so the script and the CI pin cannot drift on what counts as a
+  collective.
 
 Writes the table to stdout and appends a dated section to PERF.md when
-run with --record. CPU-only; never touches the chip
-(force_virtual_cpu_devices before any jax call).
+run with --record (`--engine core|flat` restricts the sweep). CPU-only;
+never touches the chip (force_virtual_cpu_devices before any jax call).
 """
 
-import re
 import sys
 
 sys.path.insert(0, "/root/repo")
@@ -29,7 +33,11 @@ force_virtual_cpu_devices(8)
 
 import jax  # noqa: E402
 
-from sparksched_tpu.parallel import make_mesh  # noqa: E402
+from sparksched_tpu.parallel import (  # noqa: E402
+    collective_census,
+    compiled_flops,
+    make_mesh,
+)
 from sparksched_tpu.trainers.ppo import PPO  # noqa: E402
 
 AGENT = {
@@ -51,24 +59,15 @@ TRAIN = {
     "max_grad_norm": 0.5, "rollout_steps": 48,
 }
 
-COLLECTIVE_RE = re.compile(
-    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
-    r"all-to-all)\b"
-)
-
-
-def collectives(hlo_text: str) -> dict[str, int]:
-    counts: dict[str, int] = {}
-    for m in COLLECTIVE_RE.finditer(hlo_text):
-        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
-    return counts
-
-
-def main() -> None:
+def sweep(engine: str) -> list[dict]:
     rows = []
     for dp in (1, 2, 4, 8):
         mesh = make_mesh(dp)
-        t = PPO(AGENT, ENV, TRAIN, mesh=mesh)
+        train = TRAIN | {
+            "rollout_engine": engine,
+            "artifacts_dir": f"/tmp/mesh_acct_{engine}",
+        }
+        t = PPO(AGENT, ENV, train, mesh=mesh)
         state = t.init_state()
 
         lowered_c = t._collect_jit.lower(
@@ -81,50 +80,76 @@ def main() -> None:
         shard_shape = ro.obs.duration.sharding.shard_shape(
             ro.obs.duration.shape
         )
-        flops_c = comp_c.cost_analysis()["flops"]
 
         lowered_u = t._update_jit.lower(state, ro)
         comp_u = lowered_u.compile()
-        flops_u = comp_u.cost_analysis()["flops"]
-        colls = collectives(comp_u.as_text())
 
         rows.append({
+            "engine": engine
+            + ("+single_eval" if engine == "flat"
+               and t.flat_single_eval else ""),
             "dp": dp,
             "global_lanes": t.num_envs,
             "lane_shard": shard_shape[0],
             "obs_shard_shape": "x".join(map(str, shard_shape)),
-            "collect_gflops": flops_c / 1e9,
-            "update_gflops": flops_u / 1e9,
-            "update_collectives": colls,
+            "collect_gflops": compiled_flops(comp_c) / 1e9,
+            "update_gflops": compiled_flops(comp_u) / 1e9,
+            "update_collectives": collective_census(comp_u.as_text()),
         })
         print(rows[-1], flush=True)
+    return rows
 
-    base_c = rows[0]["collect_gflops"]
-    base_u = rows[0]["update_gflops"]
+
+def main() -> None:
+    engines = ("core", "flat")
+    for i, a in enumerate(sys.argv):
+        if a == "--engine":
+            if i + 1 >= len(sys.argv):
+                sys.exit("--engine needs a value: core, flat, or "
+                         "core,flat")
+            engines = tuple(sys.argv[i + 1].split(","))
+            bad = set(engines) - {"core", "flat"}
+            if bad:
+                # an unknown string would silently run the core engine
+                # under the typo'd label and append it to PERF.md as a
+                # distinct measured engine
+                sys.exit(f"unknown --engine value(s) {sorted(bad)}; "
+                         "valid: core, flat")
+    rows = [r for e in engines for r in sweep(e)]
+
+    base = {
+        r["engine"]: (r["collect_gflops"], r["update_gflops"])
+        for r in rows if r["dp"] == 1
+    }
     lines = [
         "",
         "## Mesh scaling accounting (virtual CPU mesh, "
         "scripts_mesh_accounting.py)",
         "",
         "Fixed global batch (16 lanes x 48 steps, 8-job envs), lanes "
-        "sharded over a 1-D dp mesh, params replicated. XLA "
-        "`cost_analysis` FLOPs are per-device for SPMD programs; the "
-        "table shows per-device work dropping ~1/dp while the update "
-        "pays a fixed small set of collectives (gradient psum + "
-        "global-permutation gathers) — the quantitative form of the "
-        "scaling claim the driver's dryrun only gate-checks.",
+        "sharded over a 1-D dp mesh, params replicated, for BOTH "
+        "rollout engines — `core` (per-decision scan) and "
+        "`flat+single_eval` (the single-eval micro-step collector, the "
+        "production path ISSUE 6 ships sharded). XLA `cost_analysis` "
+        "FLOPs are per-device for SPMD programs; the table shows "
+        "per-device work dropping ~1/dp while the update pays only the "
+        "reduction-family collectives (gradient psum + advantage "
+        "normalization; the shard-aligned fold_in minibatch keys keep "
+        "resharding families out — tests/test_parallel.py pins this).",
         "",
-        "| dp | lanes/device | obs shard [B,T,J,S] | collect GFLOP/dev "
-        "(x of dp=1) | update GFLOP/dev (x of dp=1) | update "
+        "| engine | dp | lanes/device | obs shard [B,T,J,S] | collect "
+        "GFLOP/dev (x of dp=1) | update GFLOP/dev (x of dp=1) | update "
         "collectives |",
-        "|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         colls = ", ".join(
             f"{k}:{v}" for k, v in sorted(r["update_collectives"].items())
         ) or "none"
+        base_c, base_u = base[r["engine"]]
         lines.append(
-            f"| {r['dp']} | {r['lane_shard']} | {r['obs_shard_shape']} "
+            f"| {r['engine']} | {r['dp']} | {r['lane_shard']} "
+            f"| {r['obs_shard_shape']} "
             f"| {r['collect_gflops']:.2f} "
             f"({r['collect_gflops'] / base_c:.2f}x) "
             f"| {r['update_gflops']:.2f} "

@@ -10,7 +10,7 @@ Flags:
                                   counts) — the bench stamp subprocess
                                   uses this
   --programs observe,micro_step   registry subset for the jaxpr/memory
-                                  passes (default: all 7; unknown names
+                                  passes (default: all 8; unknown names
                                   are an error)
   --mem-compile                   additionally AOT-compile every
                                   registry program on the current

@@ -89,7 +89,10 @@ class Budget:
 # implicit-dtype lint fixes, and to the tests/test_jaxpr_budget.py pin
 # this table absorbed), micro_step 4734/69/1, decide_micro_step
 # 2729/28/1, drain_to_decision 3374/45/1, decima_score 491/8/2,
-# decima_batch_policy 733/13/2, ppo_update 2856/43/3.
+# decima_batch_policy 733/13/2, ppo_update 2856/43/3 (re-measured
+# 2860/43/3 after the ISSUE-6 fold_in minibatch-key derivation),
+# flat_collect_batch 13407/216/18 (ISSUE 6: 4 lanes x 3 decision
+# rows of the single-eval batch collector).
 # ---------------------------------------------------------------------------
 
 BUDGETS: dict[str, Budget] = {
@@ -127,6 +130,16 @@ BUDGETS: dict[str, Budget] = {
     # one PPO update (epochs x minibatches scan, remat'd GNN recompute)
     "ppo_update": Budget(
         eqn_lo=1000, eqn_hi=3900, gather_hi=60, scatter_hi=5,
+    ),
+    # the single-eval batch collector over a native [B] lane axis —
+    # the program the dp mesh shards (ISSUE 6): decide + drain + ONE
+    # Decima batch_policy per decision row inside a short scan, with
+    # the per-decision buffer scatters. The jaxpr is dp-invariant
+    # (sharding is applied at lowering, not tracing), which is exactly
+    # what makes this CPU audit valid for the sharded configuration;
+    # the HLO-level collective census lives in tests/test_parallel.py.
+    "flat_collect_batch": Budget(
+        eqn_lo=9000, eqn_hi=18100, gather_hi=292, scatter_hi=25,
     ),
 }
 
@@ -318,6 +331,58 @@ LANE_PROGRAMS = (
     "observe", "micro_step", "decide_micro_step", "drain_to_decision",
 )
 
+# batch programs: registry programs that take the lane axis NATIVELY
+# (no outer vmap) — the single-eval collectors the dp mesh shards. The
+# memory pass applies the bank-broadcast rule to their traced batch
+# axis directly and drives the lane-fit advisor by re-tracing at each
+# base batch width (`flat_collect_batch_callable(batch)`).
+BATCH_LANE_PROGRAMS = ("flat_collect_batch",)
+
+# lane/scan widths of the audited batch collector: 4 lanes x 3
+# decision rows keeps the ~13k-eqn trace a few seconds while still
+# containing every production phase (batch policy, decide, drain,
+# scatter) — eqn counts are shape-independent, so the budgets hold at
+# flagship scale
+AUDIT_COLLECT_BATCH = 4
+AUDIT_COLLECT_STEPS = 3
+
+
+def flat_collect_batch_callable(
+    batch: int = AUDIT_COLLECT_BATCH,
+) -> tuple[Callable, tuple]:
+    """The single-eval flat sync collector over a native [batch] lane
+    axis with the shipped Decima batch policy — the program
+    `parallel:` mesh configs shard over dp
+    (trainers/rollout.py:collect_flat_sync_batch; the async variant
+    shares the same scan body). As (callable, abstract args); `batch`
+    parameterizes the lane width so the memory pass can fit its
+    per-lane byte model from two widths."""
+    import jax
+
+    from ..schedulers.decima import DecimaScheduler
+    from ..trainers.rollout import collect_flat_sync_batch
+
+    params, bank, state = audit_setup()
+    # compaction bucket scaled to the audit job cap, as for the
+    # decima_* programs, so BOTH score branches are in the audit
+    sched = DecimaScheduler(
+        num_executors=params.num_executors, job_bucket=8,
+        **_shipped_agent_kwargs(),
+    )
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    states_b = _batched(state, batch)
+
+    def fn(s, r):
+        return collect_flat_sync_batch(
+            params, bank,
+            lambda rr, oo: sched.batch_policy(rr, oo),
+            r, AUDIT_COLLECT_STEPS, s,
+            event_bulk=True, bulk_events=8, fulfill_bulk=True,
+            bulk_cycles=1,
+        )
+
+    return fn, (states_b, key)
+
 
 def lane_callables() -> dict[str, tuple[Callable, tuple]]:
     """The per-lane registry programs as (callable, UNBATCHED abstract
@@ -419,6 +484,8 @@ def program_callables(names: tuple[str, ...] | None = None
 
     if want is None or "ppo_update" in want:
         out["ppo_update"] = ppo_update_callable()
+    if want is None or "flat_collect_batch" in want:
+        out["flat_collect_batch"] = flat_collect_batch_callable()
     return out
 
 

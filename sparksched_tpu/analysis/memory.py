@@ -35,7 +35,7 @@ default 17.2 GB = the v5-lite part in PERF.md).
 
 Backend-true accounting (`compiled.memory_analysis()` after a real AOT
 compile) is NOT part of the default pass — it is backend-dependent
-(CPU folds, TPU pads) and compiling all seven programs would roughly
+(CPU folds, TPU pads) and compiling every registry program would roughly
 double the gate's cost. `program_memory_accounting(compile=True)`
 exposes it for the chip session (stage 11) and the CLI's
 `--mem-compile` flag.
@@ -53,7 +53,8 @@ boundaries move; a band breach means structural allocation growth
 Pinned 2026-08 (jax 0.4.37, threefry, CPU trace, tile-padded audit
 shapes) — measured temp-total MB: observe 2.3, micro_step 22.1,
 decide_micro_step 9.9, drain_to_decision 16.2, decima_score 153.6,
-decima_batch_policy 169.2, ppo_update 269.6. (The decima/ppo programs
+decima_batch_policy 169.2, ppo_update 269.6, flat_collect_batch 357.7
+(ISSUE 6: 4-lane x 3-row single-eval batch collector). (The decima/ppo programs
 carry a 4-lane batch in their audited shapes, and tile padding
 inflates narrow minor dims — these are model numbers for regression
 detection, not literal HBM footprints; the lane-fit table is the
@@ -67,12 +68,23 @@ from typing import Any
 
 from . import Violation
 from .jaxpr_audit import (
+    AUDIT_COLLECT_BATCH,
+    BATCH_LANE_PROGRAMS,
     LANE_PROGRAMS,
     audit_setup,
     build_programs,
+    flat_collect_batch_callable,
     lane_callables,
     program_callables,
 )
+
+# batch-width-parameterized builders for BATCH_LANE_PROGRAMS — the
+# lane-fit advisor re-traces these at a second width to fit its
+# per-lane byte model (keep in one-to-one sync with the tuple)
+BATCH_PROGRAM_BUILDERS = {
+    "flat_collect_batch": flat_collect_batch_callable,
+}
+assert set(BATCH_PROGRAM_BUILDERS) == set(BATCH_LANE_PROGRAMS)
 from ..obs.memory import (
     TPU_HBM_BUDGET_BYTES,
     _iter_eqns,
@@ -110,6 +122,12 @@ MEM_BUDGETS: dict[str, MemBudget] = {
     "decima_score": MemBudget(temp_hi=210 * MB),
     "decima_batch_policy": MemBudget(temp_hi=230 * MB),
     "ppo_update": MemBudget(temp_hi=365 * MB),
+    # ISSUE 6: the single-eval batch collector the dp mesh shards,
+    # audited at its native 4-lane batch (audit shapes are per-REPLICA:
+    # under a dp mesh each device holds a 1/dp shard of every
+    # lane-batched buffer, which is what the lane-fit advisor's `mesh`
+    # mode models — these bytes bound the unsharded audit program)
+    "flat_collect_batch": MemBudget(temp_hi=485 * MB),
 }
 
 # lane counts the advisor sweeps (the bench's production range; 1024
@@ -203,9 +221,10 @@ def audit_memory(
     _, bank, _ = audit_setup()
     found: list[Violation] = []
     measured: dict[str, Any] = {}
+    programs = build_programs(names)
 
     # -- unbatched accounting + the bytes budget ------------------------
-    for name, closed in build_programs(names).items():
+    for name, closed in programs.items():
         est = jaxpr_memory_estimate(closed, tile_pad=True, top_k=3)
         budget = MEM_BUDGETS.get(name)
         measured[name] = {
@@ -265,6 +284,44 @@ def audit_memory(
                     None,
                 ),
             }
+
+    # -- batch programs (native lane axis): the sharded collectors ------
+    # The single-eval collectors take the lane stack directly, so the
+    # registry trace ALREADY carries the batch axis: the bank-broadcast
+    # rule scans it as-traced (a lane-batched bank table here is the
+    # same 19.4 GB class — and under a dp mesh it would materialize
+    # per SHARD, i.e. the rule must see one replicated bank per
+    # device, not a per-lane broadcast), and the lane-fit advisor fits
+    # its model by re-tracing at a second batch width instead of
+    # vmapping.
+    for name in BATCH_LANE_PROGRAMS:
+        if names is not None and name not in names:
+            continue
+        found.extend(check_bank_broadcast(
+            name, programs[name], bank, AUDIT_COLLECT_BATCH
+        ))
+
+        def _tracer(b, _builder=BATCH_PROGRAM_BUILDERS[name]):
+            import jax
+
+            fn, args = _builder(batch=b)
+            return jax.make_jaxpr(fn)(*args)
+
+        fit = lane_fit(
+            candidates=LANE_FIT_CANDIDATES, budget_bytes=budget_bytes,
+            base_lanes=(2, AUDIT_COLLECT_BATCH),
+            traced={AUDIT_COLLECT_BATCH: programs[name]},
+            tracer=_tracer,
+        )
+        measured[name]["lane_fit"] = {
+            "budget_gb": gb(budget_bytes),
+            "max_lanes_fit": fit["max_lanes_fit"],
+            "at_1024_gb": next(
+                (gb(r["est_peak_bytes"])
+                 for r in fit["candidates"] if r["lanes"] == 1024),
+                None,
+            ),
+        }
     return found, measured
 
 

@@ -24,7 +24,11 @@ observable on three layers:
 - **the lane-fit advisor** (`lane_fit`): trace `vmap(fn)` at two small
   lane counts, fit a per-buffer linear model bytes(B) = a + b*B, and
   evaluate any candidate lane count against an HBM budget in O(1) —
-  the question bench calibration used to answer by crashing. The
+  the question bench calibration used to answer by crashing. With
+  `mesh`, the budget is per DEVICE: candidates stay global lane
+  counts, each evaluated at its ceil(lanes/dp) shard width against
+  17.2 GB/chip — "max lanes per shard", the multi-chip scale-out's
+  memory question. The
   estimate is a *lower bound* (largest single-equation working set +
   arguments + outputs + constants; real peaks add allocator slack), so
   "does not fit" is trustworthy and "fits" means "no single buffer
@@ -293,6 +297,15 @@ def _linear_fit(y1: int, y2: int, b1: int, b2: int
     return y1 - slope * b1, slope
 
 
+def _mesh_dp(mesh) -> int:
+    """Device count of a `mesh` argument: a Mesh, an int, or None."""
+    if mesh is None:
+        return 1
+    if isinstance(mesh, int):
+        return max(1, mesh)
+    return max(1, int(getattr(mesh, "size", 1)))
+
+
 def lane_fit(
     fn: Callable | None = None,
     example_args: tuple | None = None,
@@ -302,6 +315,7 @@ def lane_fit(
     base_lanes: tuple[int, int] = (2, 4),
     traced: dict[int, Any] | None = None,
     tracer: Callable[[int], Any] | None = None,
+    mesh=None,
 ) -> dict[str, Any]:
     """Sweep vmap lane counts against an HBM budget without compiling.
 
@@ -315,11 +329,22 @@ def lane_fit(
     `vmap(fn)` trace for programs that take the lane axis directly
     (e.g. the single-eval batch collectors).
 
+    `mesh` (a `jax.sharding.Mesh`, or a bare device count) makes the
+    budget PER DEVICE: candidates stay GLOBAL lane counts, but each is
+    evaluated at its per-shard width ceil(lanes/dp) against
+    `budget_bytes` per chip — the lane axis is batch-sharded under the
+    dp mesh (parallel.py:lane_sharding), so the buffers that grow with
+    lanes live ceil(B/dp) wide on every device while the bank/params
+    stay replicated (the `a` intercept of each buffer's linear model).
+    `max_lanes_fit` then answers "how many GLOBAL lanes fit this mesh",
+    and each candidate row carries `lanes_per_device`.
+
     Returns `{budget_bytes, base_lanes, max_lanes_fit,
     candidates: [{lanes, est_peak_bytes, fits, top: {...}}]}` —
     `top` names the dominant buffer (shape at that lane count +
     producing op), so an over-budget row reads "select_n
     f32[512,154,20,3,8,16] = 19.4 GB", not a bare number."""
+    dp = _mesh_dp(mesh)
     if tracer is None:
         assert fn is not None and example_args is not None
         tracer = lambda b: _trace_vmapped(fn, example_args, b)  # noqa: E731
@@ -349,7 +374,7 @@ def lane_fit(
         # the two traces disagree structurally (shape-dependent Python
         # control flow in fn): fall back to tracing every candidate
         return _lane_fit_direct(
-            tracer, candidates, budget_bytes, tile_pad
+            tracer, candidates, budget_bytes, tile_pad, dp
         )
 
     ws_models = [
@@ -391,56 +416,74 @@ def lane_fit(
     out_rows = []
     max_fit = 0
     for lanes in sorted(candidates):
+        # per-device width: the model is linear in the LANE dimension of
+        # the traced program, and under a dp mesh each device holds a
+        # ceil(lanes/dp)-wide shard of every lane-batched buffer
+        shard = -(-lanes // dp)
         fixed = (arg_m[0] + out_m[0] + con_m[0]
-                 + (arg_m[1] + out_m[1] + con_m[1]) * lanes)
-        ws_vals = [a + b * lanes for a, b in ws_models]
+                 + (arg_m[1] + out_m[1] + con_m[1]) * shard)
+        ws_vals = [a + b * shard for a, b in ws_models]
         i_top = max(range(len(ws_vals)), key=ws_vals.__getitem__)
         est = int(fixed + ws_vals[i_top])
         fits = est <= budget_bytes
         if fits:
             max_fit = max(max_fit, lanes)
-        top = _top_desc(i_top, lanes)
+        top = _top_desc(i_top, shard)
         top["working_set_bytes"] = int(ws_vals[i_top])
-        out_rows.append({
+        row = {
             "lanes": lanes,
             "est_peak_bytes": est,
             "fits": fits,
             "top": top,
-        })
-    return {
+        }
+        if dp > 1:
+            row["lanes_per_device"] = shard
+        out_rows.append(row)
+    out = {
         "budget_bytes": int(budget_bytes),
         "base_lanes": list(base_lanes),
         "max_lanes_fit": max_fit,
         "candidates": out_rows,
     }
+    if dp > 1:
+        out["dp"] = dp
+    return out
 
 
 def _lane_fit_direct(tracer, candidates, budget_bytes,
-                     tile_pad) -> dict[str, Any]:
+                     tile_pad, dp: int = 1) -> dict[str, Any]:
     """Fallback: one trace per candidate (used only when the two-point
-    linear model cannot align its traces)."""
+    linear model cannot align its traces). Under a dp mesh the trace
+    runs at the candidate's per-shard width."""
     out_rows = []
     max_fit = 0
     for lanes in sorted(candidates):
-        jx = tracer(lanes)
+        shard = -(-lanes // dp)
+        jx = tracer(shard)
         est = jaxpr_memory_estimate(jx, tile_pad, top_k=1)
         peak = est["peak_lower_bound_bytes"]
         fits = peak <= budget_bytes
         if fits:
             max_fit = max(max_fit, lanes)
         top = dict(est["largest"][0]) if est["largest"] else {}
-        out_rows.append({
+        row = {
             "lanes": lanes,
             "est_peak_bytes": int(peak),
             "fits": fits,
             "top": top,
-        })
-    return {
+        }
+        if dp > 1:
+            row["lanes_per_device"] = shard
+        out_rows.append(row)
+    out = {
         "budget_bytes": int(budget_bytes),
         "base_lanes": [],
         "max_lanes_fit": max_fit,
         "candidates": out_rows,
     }
+    if dp > 1:
+        out["dp"] = dp
+    return out
 
 
 def jax_shape_struct(shape: tuple, dtype):
@@ -460,16 +503,27 @@ def lane_fit_summary(fit: dict[str, Any]) -> dict[str, Any]:
     the analysis report)."""
     worst = fit["candidates"][-1] if fit["candidates"] else {}
     top = worst.get("top", {})
-    return {
+    out = {
         "budget_gb": gb(fit["budget_bytes"]),
         "max_lanes_fit": fit["max_lanes_fit"],
         "candidates": [
-            {"lanes": c["lanes"], "est_gb": gb(c["est_peak_bytes"]),
-             "fits": c["fits"]}
+            {
+                "lanes": c["lanes"], "est_gb": gb(c["est_peak_bytes"]),
+                "fits": c["fits"],
+            }
+            | (
+                {"lanes_per_device": c["lanes_per_device"]}
+                if "lanes_per_device" in c else {}
+            )
             for c in fit["candidates"]
         ],
         "top": {k: top.get(k) for k in ("op", "shape") if k in top},
     }
+    if "dp" in fit:
+        # per-device budget: est_gb rows above are bytes PER CHIP at
+        # each global lane count sharded dp ways
+        out["dp"] = fit["dp"]
+    return out
 
 
 def memory_row_stamp(
@@ -479,12 +533,16 @@ def memory_row_stamp(
     budget_bytes: int = TPU_HBM_BUDGET_BYTES,
     tracer: Callable[[int], Any] | None = None,
     program: str | None = None,
+    mesh=None,
 ) -> dict[str, Any]:
     """Best-effort `memory` block for a bench row: runtime allocator
     stats (null on backends without them — CPU) plus, when a lane
     program (or `tracer`) is given, the compact lane-fit prediction.
-    Never raises — a failed *accounting* step must never take a bench
-    row down; failures land as a `lane_fit: {error}` field instead."""
+    With `mesh` (or a device count), the prediction is per shard
+    against a per-chip budget — what a dp-sharded bench row must stamp
+    (global lanes, per-device bytes). Never raises — a failed
+    *accounting* step must never take a bench row down; failures land
+    as a `lane_fit: {error}` field instead."""
     stats = device_memory_stats() or {}
     out: dict[str, Any] = {
         "mem_peak_bytes": stats.get("peak_bytes_in_use"),
@@ -496,7 +554,7 @@ def memory_row_stamp(
         try:
             out["lane_fit"] = lane_fit_summary(lane_fit(
                 lane_fn, example_args, candidates=candidates,
-                budget_bytes=budget_bytes, tracer=tracer,
+                budget_bytes=budget_bytes, tracer=tracer, mesh=mesh,
             ))
         except Exception as e:
             out["lane_fit"] = {

@@ -17,6 +17,9 @@ The TPU-native equivalent has two layers:
 
 from __future__ import annotations
 
+import re
+from typing import Any
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -89,3 +92,87 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def shard_lanes(tree, mesh: Mesh):
     """Place a [B, ...] pytree with its lane axis sharded over the mesh."""
     return jax.device_put(tree, lane_sharding(mesh))
+
+
+def constrain_lanes(tree, sharding: NamedSharding):
+    """`with_sharding_constraint` every leaf's leading (lane) axis —
+    applied to the collection scan's carry buffers so XLA's SPMD
+    partitioner keeps them lane-sharded instead of falling back to a
+    replicated layout mid-scan (every leaf must carry a leading [B]
+    axis; scalars like the scan's PRNG key stay outside the tree)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.with_sharding_constraint(a, sharding), tree
+    )
+
+
+# ---------------------------------------------------------------------------
+# config wiring: the `parallel:` YAML block
+# ---------------------------------------------------------------------------
+
+
+def mesh_from_config(cfg: dict[str, Any] | None) -> Mesh | None:
+    """Resolve the top-level `parallel:` config block to a mesh.
+
+    Contract (config/decima_tpch_multichip.yaml documents the YAML
+    side): `dp: auto` takes every visible device; `dp: N` demands
+    exactly N and fails loudly when the host has fewer (a silent
+    single-chip fallback would report sharded dec/s that never
+    sharded). A resolved dp of 1 returns None — the unsharded jit path
+    is the same program without the sharding plumbing, and a 1-device
+    mesh would only add layout bookkeeping."""
+    if not cfg:
+        return None
+    dp = cfg.get("dp", "auto")
+    if dp in ("auto", None):
+        dp = len(jax.devices())
+    dp = int(dp)
+    if dp <= 1:
+        return None
+    return make_mesh(dp)
+
+
+# ---------------------------------------------------------------------------
+# collective census: the HLO-level contract of the sharded update
+# ---------------------------------------------------------------------------
+
+COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)\b"
+)
+
+# what the shard-aligned update is ALLOWED to lower to: the gradient /
+# advantage-normalization reductions (all-reduce), their occasional
+# reduce-scatter re-association, and the small gathers of per-shard
+# scalars (KL early-stop predicate, loss means)
+EXPECTED_UPDATE_COLLECTIVES = frozenset(
+    {"all-reduce", "all-gather", "reduce-scatter"}
+)
+# what it must NEVER contain: resharding families. An all-to-all or
+# collective-permute in the update means the minibatch permutation
+# stopped being shard-aligned (e.g. someone reintroduced a global
+# B*T shuffle) and every grad step now pays a full rollout reshuffle
+# over ICI/DCN — the regression tests/test_parallel.py's census pins.
+FORBIDDEN_UPDATE_COLLECTIVES = frozenset(
+    {"all-to-all", "collective-permute"}
+)
+
+
+def compiled_flops(compiled) -> float:
+    """Per-device FLOPs from an AOT-compiled program's cost analysis.
+    `Compiled.cost_analysis()` returned a bare dict before jax 0.4.30ish
+    and a one-element list of dicts after — accept both (the mesh
+    accounting script and the dp-scaling test share this)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float(ca.get("flops", 0.0))
+
+
+def collective_census(hlo_text: str) -> dict[str, int]:
+    """Count collective ops in an optimized-HLO dump, by family.
+    Shared by the mesh-accounting script and the census test so the
+    two cannot drift on what counts as a collective."""
+    counts: dict[str, int] = {}
+    for m in COLLECTIVE_RE.finditer(hlo_text):
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts

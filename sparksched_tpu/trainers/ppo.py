@@ -86,9 +86,20 @@ class PPO(Trainer):
         nb = self.num_batches
         mbs = -(-T // nb)
         rng = jax.random.fold_in(state.rng, 13)
-        lane_keys = jax.vmap(jax.random.split, in_axes=(0, None))(
-            jax.random.split(rng, self.num_epochs), B
-        )  # [E, B, 2]
+        # per-(epoch, lane) permutation keys via fold_in over a lane
+        # iota — elementwise in the lane index, so each dp shard derives
+        # its local lanes' keys from the replicated rng. The previous
+        # vmap(split) derivation materialized one global [E*B] key strip
+        # whose distribution onto lane shards lowered to
+        # collective-permute chains (the resharding family the census
+        # test forbids); fold_in keeps the update's collective set to
+        # the reduction families alone.
+        ep_keys = jax.random.split(rng, self.num_epochs)  # [E, 2]
+        lane_keys = jax.vmap(
+            lambda ek: jax.vmap(
+                lambda b: jax.random.fold_in(ek, b)
+            )(jnp.arange(B))
+        )(ep_keys)  # [E, B]
         perms = jax.vmap(jax.vmap(lambda k: jax.random.permutation(k, T)))(
             lane_keys
         )  # [E, B, T]

@@ -690,11 +690,20 @@ def _flat_collect_single_eval(
     rollout_duration,
     use_elapsed: bool,
     telemetry=None,
+    lane_shard=None,
 ):
     """Shared single-eval collection scan over the WHOLE lane batch
     (`ls` carries a leading [B] axis; no outer vmap). Exactly
     `num_steps` scan iterations, each producing at most one decision
-    per lane; see the section comment above for the shape."""
+    per lane; see the section comment above for the shape.
+
+    `lane_shard` (a `NamedSharding` over the lane axis, parallel.py:
+    `lane_sharding`) pins the scan's carry — the [B] `LoopState`, the
+    [B,T] decision buffers and the per-lane telemetry — to the dp mesh
+    via `with_sharding_constraint`, so the whole collection runs SPMD
+    with the lane axis sharded end-to-end instead of leaving the carry
+    layout to the partitioner's fallback (which can silently replicate
+    the largest resident buffers of the program)."""
     track = telemetry is not None
     T = num_steps
     B = ls.mode.shape[0]
@@ -712,6 +721,13 @@ def _flat_collect_single_eval(
         walls=jnp.zeros((B, T), jnp.float32),
         resets=jnp.zeros((B, T), _i32),
     )
+    if lane_shard is not None:
+        from ..parallel import constrain_lanes
+
+        ls = constrain_lanes(ls, lane_shard)
+        buf0 = constrain_lanes(buf0, lane_shard)
+        if track:
+            telemetry = constrain_lanes(telemetry, lane_shard)
     lane_idx = jnp.arange(B)
 
     def v_decide(ls, si, ne, keys, li, tm):
@@ -869,6 +885,7 @@ def _flat_collect_single_eval(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "event_bulk", "bulk_events", "fulfill_bulk", "bulk_cycles",
+        "lane_shard",
     ),
 )
 def collect_flat_sync_batch(
@@ -884,19 +901,22 @@ def collect_flat_sync_batch(
     bulk_events: int = 8,
     fulfill_bulk: bool = True,
     bulk_cycles: int = 1,
+    lane_shard=None,
 ) -> Rollout | tuple:
     """Single-eval flat equivalent of `vmap(collect_sync)`: one episode
     per lane from the given freshly-reset [B] states, exactly one policy
     evaluation per decision row (no `micro_groups` sizing — the scan
     length IS `num_steps`). With `telemetry` ([B]-leading), returns
-    `(Rollout, Telemetry)`."""
+    `(Rollout, Telemetry)`. `lane_shard` (static; a lane-axis
+    `NamedSharding`) runs the collection SPMD over a dp mesh — see
+    `_flat_collect_single_eval`."""
     ls = jax.vmap(init_loop_state)(states)
     out = _flat_collect_single_eval(
         params, bank, batch_policy_fn, rng, num_steps, ls,
         auto_reset=False, event_bulk=event_bulk,
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fns=None, rollout_duration=None,
-        use_elapsed=False, telemetry=telemetry,
+        use_elapsed=False, telemetry=telemetry, lane_shard=lane_shard,
     )
     return (out[0], out[2]) if telemetry is not None else out[0]
 
@@ -905,6 +925,7 @@ def collect_flat_sync_batch(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "event_bulk", "bulk_events", "fulfill_bulk", "bulk_cycles",
+        "lane_shard",
     ),
 )
 def collect_flat_async_batch(
@@ -924,13 +945,17 @@ def collect_flat_async_batch(
     bulk_events: int = 8,
     fulfill_bulk: bool = True,
     bulk_cycles: int = 1,
+    lane_shard=None,
 ) -> tuple:
     """Single-eval flat equivalent of `vmap(collect_flat_async)`:
     persistent [B] lanes, fixed sim-time budget, group-shared mid-scan
     reset sequences from `fold_in(seq_bases[i], reset_counts[i] +
     completed_episodes)`. Budget granularity is the decision row (the
     same as `collect_async`). Returns `(Rollout, LoopState[,
-    Telemetry])`."""
+    Telemetry])`. `lane_shard` (static) runs the collection SPMD over
+    a dp mesh — see `_flat_collect_single_eval`; the returned
+    `LoopState` carry stays lane-sharded, so the next iteration's
+    collection starts from shards already resident on their devices."""
     rollout_duration = jnp.float32(rollout_duration)
     B = loop_states.mode.shape[0]
     if seq_bases is None:
@@ -960,7 +985,7 @@ def collect_flat_async_batch(
         auto_reset=True, event_bulk=event_bulk, bulk_events=bulk_events,
         fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
         reset_fns=reset_fns, rollout_duration=rollout_duration,
-        use_elapsed=True, telemetry=telemetry,
+        use_elapsed=True, telemetry=telemetry, lane_shard=lane_shard,
     )
     ro, ls = out[0], out[1]
     ro = ro.replace(final_reset_count=reset_counts + ls.episodes)

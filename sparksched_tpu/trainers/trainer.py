@@ -22,6 +22,14 @@ the per-decision `core.step` scan or the flat micro-step engine
 `flat_bulk_events` / `flat_fulfill_bulk` / `flat_bulk_cycles` expose the
 flat engine's calibration surface (bench.py documents the per-backend
 winners).
+
+Multi-chip: a top-level `parallel:` YAML block (`dp: auto|N`) builds a
+1-D dp mesh (parallel.py) and runs the whole iteration SPMD — rollout
+lanes sharded over the mesh, parameters replicated, the update's
+gradient/advantage reductions lowered to one all-reduce family per step
+(the minibatch permutation is shard-aligned by construction, see
+trainers/ppo.py). `num_sequences * num_rollouts` must divide evenly
+over the mesh.
 """
 
 from __future__ import annotations
@@ -326,8 +334,13 @@ class Trainer(abc.ABC):
 
         # SPMD over a device mesh: rollout lanes sharded along the dp axis,
         # parameters replicated; the update's cross-lane reductions lower to
-        # XLA collectives (see parallel.py)
+        # XLA collectives (see parallel.py). The persistent async carry
+        # (env_states, arg 3) is donated on both paths: the host never
+        # reads it between iterations, and donation lets XLA alias the
+        # lane-sharded LoopState buffers across iterations instead of
+        # holding two copies of the largest resident state per device.
         self.mesh = mesh
+        self._lane_sharding = None
         if mesh is not None:
             from ..parallel import lane_sharding
 
@@ -336,15 +349,23 @@ class Trainer(abc.ABC):
                 f"num_sequences*num_rollouts={self.num_envs} must divide "
                 f"evenly over {mesh.size} devices"
             )
+            self._lane_sharding = lanes
+            # every _collect output is lane-leading: the Rollout, the
+            # async (LoopState, reset_counts) carry, and the per-lane
+            # Telemetry — shard them all, or the carry round-trips
+            # through a replicated layout every iteration
             self._collect_jit = jax.jit(
-                self._collect, out_shardings=(lanes, None, None)
+                self._collect, out_shardings=(lanes, lanes, lanes),
+                donate_argnums=(3,),
             )
             self._update_jit = jax.jit(
                 self._update, in_shardings=(None, lanes),
                 out_shardings=None,
             )
         else:
-            self._collect_jit = jax.jit(self._collect)
+            self._collect_jit = jax.jit(
+                self._collect, donate_argnums=(3,)
+            )
             self._update_jit = jax.jit(self._update)
 
     # ------------------------------------------------------------------
@@ -440,6 +461,7 @@ class Trainer(abc.ABC):
                     jax.random.fold_in(rng, 7), self.rollout_steps,
                     states, self.rollout_duration, seq_bases,
                     lane_salts, reset_counts, telem0,
+                    lane_shard=self._lane_sharding,
                     **self.flat_batch_knobs,
                 )
                 ro, loop_states, telem = (
@@ -478,7 +500,9 @@ class Trainer(abc.ABC):
                 out = collect_flat_sync_batch(
                     p, bank, batch_policy_fn,
                     jax.random.fold_in(rng, 7), self.rollout_steps,
-                    states, telem0, **self.flat_batch_knobs,
+                    states, telem0,
+                    lane_shard=self._lane_sharding,
+                    **self.flat_batch_knobs,
                 )
             elif flat:
                 out = jax.vmap(
@@ -795,7 +819,13 @@ class Trainer(abc.ABC):
 def make_trainer(cfg: CfgType) -> Trainer:
     """String-keyed factory (reference trainers/__init__.py:7-13); the
     optional top-level `obs:` YAML section configures the observability
-    block (runlog / telemetry / trace capture)."""
+    block (runlog / telemetry / trace capture) and the optional
+    `parallel:` section (`dp: auto|N`) shards rollout lanes over a
+    device mesh — params replicated, `EnvState`/`Rollout`/`Telemetry`
+    batch-sharded, the PPO update's reductions lowered to XLA
+    collectives (parallel.py; config/decima_tpch_multichip.yaml is the
+    worked example)."""
+    from ..parallel import mesh_from_config
     from .ppo import PPO
     from .vpg import VPG
 
@@ -804,5 +834,7 @@ def make_trainer(cfg: CfgType) -> Trainer:
     if name not in registry:
         raise ValueError(f"'{name}' is not a valid trainer.")
     return registry[name](
-        cfg["agent"], cfg["env"], cfg["trainer"], obs_cfg=cfg.get("obs")
+        cfg["agent"], cfg["env"], cfg["trainer"],
+        mesh=mesh_from_config(cfg.get("parallel")),
+        obs_cfg=cfg.get("obs"),
     )

@@ -22,7 +22,10 @@ import pytest
 
 def test_shipped_tree_is_analysis_clean():
     from sparksched_tpu.analysis import DEFAULT_PASSES, run_all
-    from sparksched_tpu.analysis.jaxpr_audit import LANE_PROGRAMS
+    from sparksched_tpu.analysis.jaxpr_audit import (
+        BATCH_LANE_PROGRAMS,
+        LANE_PROGRAMS,
+    )
 
     report = run_all(DEFAULT_PASSES)
     assert report["clean"], "\n".join(
@@ -36,15 +39,16 @@ def test_shipped_tree_is_analysis_clean():
     all_programs = {
         "observe", "micro_step", "decide_micro_step",
         "drain_to_decision", "decima_score", "decima_batch_policy",
-        "ppo_update",
+        "ppo_update", "flat_collect_batch",
     }
     assert set(report["passes"]["jaxpr"]["measured"]) == all_programs
     mem = report["passes"]["memory"]["measured"]
     assert set(mem) == all_programs
-    # every lane program carries a lane-fit verdict, and the shipped
-    # (post-81e77fb) engine fits the full 1024-lane production width
-    # under the default 17.2 GB budget
-    for name in LANE_PROGRAMS:
+    # every lane program — vmapped AND native-batch (the sharded
+    # single-eval collector, ISSUE 6) — carries a lane-fit verdict,
+    # and the shipped (post-81e77fb) engine fits the full 1024-lane
+    # production width under the default 17.2 GB budget
+    for name in LANE_PROGRAMS + BATCH_LANE_PROGRAMS:
         assert mem[name]["lane_fit"]["max_lanes_fit"] >= 1024, name
 
 
