@@ -49,7 +49,7 @@ from sparksched_tpu.env import core
 from sparksched_tpu.env.flat_loop import init_loop_state, run_flat
 from sparksched_tpu.obs.telemetry import summarize, telemetry_zeros_like
 from sparksched_tpu.schedulers.heuristics import round_robin_policy
-from sparksched_tpu.workload import make_workload_bank
+from sparksched_tpu.workload import bank_dtype_label, make_workload_bank
 
 import os
 
@@ -110,6 +110,18 @@ FULFILL_BULK = bool(int(_FB_ENV)) if _FB_ENV is not None else None
 # (flat_loop._bulk_cycle_chain); unset -> calibrated
 _BC_ENV = os.environ.get("BENCH_BULK_CYCLES")
 BULK_CYCLES = int(_BC_ENV) if _BC_ENV is not None else None
+# ISSUE 7: single fused bulk kernel (core._bulk_events_fused — mixed
+# relaunch/arrival runs in exact queue order, one pass per cycle) vs
+# the round-3/4 (relaunch cascade + arrival burst) pass pair.
+# Step-exact either way (tests/test_flat_loop.py), so this is purely a
+# dispatch-count A/B knob; BENCH_BULK_FUSED=0 runs the unfused pair.
+BULK_FUSED = os.environ.get("BENCH_BULK_FUSED", "1") == "1"
+# ISSUE 7 low-precision bank layout: BENCH_BANK_DTYPE in
+# {int8,int16,bf16} re-encodes the workload bank's dur table via
+# workload.quantize_bank (f32 accumulation at the single gather site);
+# every row stamps config.dtype with the bank's actual dur dtype so
+# the A/B is recorded, never inferred
+BANK_DTYPE = os.environ.get("BENCH_BANK_DTYPE") or None
 MICRO_CHUNK = 256  # micro-steps per timed scan (BURST per scan group)
 assert NUM_ENVS % SUB_BATCH == 0, (
     f"BENCH_SUB_BATCH={SUB_BATCH} must divide {NUM_ENVS}"
@@ -117,7 +129,11 @@ assert NUM_ENVS % SUB_BATCH == 0, (
 assert 1 <= BURST <= MICRO_CHUNK and MICRO_CHUNK % BURST == 0, (
     f"BENCH_BURST={BURST} must be a divisor of {MICRO_CHUNK}"
 )
-NUM_CHUNKS = 4
+# timed chunks; BENCH_NUM_CHUNKS raises it for small-lane A/Bs whose
+# default window is seconds long (machine noise swamps a short window
+# — the ISSUE-7 fusion A/B measured ±20% run-to-run at 8 lanes x 4
+# chunks; the chunk count rides the row's config for comparability)
+NUM_CHUNKS = int(os.environ.get("BENCH_NUM_CHUNKS", 4))
 TARGET = 50_000.0  # steps/sec north-star (BASELINE.json)
 # extra bulk_cycles values tried when BENCH_BULK_CYCLES is unset (the
 # baseline candidate always runs bc=1); the CPU fallback shrinks this —
@@ -183,7 +199,7 @@ def _fit_lane_callable(params, bank, bulk_events, fulfill_bulk,
             event_bulk=bulk_events > 0,
             bulk_events=max(bulk_events, 1),
             fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
-            loop_state=ls,
+            loop_state=ls, bulk_fused=BULK_FUSED,
         )
 
     return lane
@@ -277,7 +293,7 @@ def bench_chunk(params: EnvParams, bank, loop_states, rngs, bulk_events,
             event_bulk=bulk_events > 0,
             bulk_events=max(bulk_events, 1),
             fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
-            loop_state=ls, telemetry=tm,
+            loop_state=ls, telemetry=tm, bulk_fused=BULK_FUSED,
         )
 
     b = jax.tree_util.tree_leaves(rngs)[0].shape[0]
@@ -334,7 +350,9 @@ def main() -> None:
         job_arrival_rate=4e-5,
         mean_time_limit=None,
     )
-    bank = make_workload_bank(params.num_executors, params.max_stages)
+    bank = make_workload_bank(
+        params.num_executors, params.max_stages, bank_dtype=BANK_DTYPE
+    )
     if bank.max_stages != params.max_stages:
         params = params.replace(
             max_stages=bank.max_stages, max_levels=bank.max_stages
@@ -578,6 +596,7 @@ def main() -> None:
         "analysis_clean": analysis_clean_stamp(),
         "config": {
             "num_envs": NUM_ENVS,
+            "num_chunks": NUM_CHUNKS,
             "sub_batch": SUB_BATCH,
             # None: pinned by env var / CPU / lane count not applicable;
             # "ok"/"failed: ...": the 1024-lane single-pass retry outcome
@@ -586,6 +605,12 @@ def main() -> None:
             "bulk_events": int(bulk_events),
             "fulfill_bulk": bool(fulfill_bulk),
             "bulk_cycles": int(bulk_cycles),
+            # ISSUE 7: fused-bulk-kernel knob + the bank's dur-table
+            # dtype ("f32"/"bf16"/"int8"/"int16") — rows are only
+            # comparable at equal engine AND layout config
+            "bulk_fused": BULK_FUSED,
+            "dtype": bank_dtype_label(bank),
+            "obs_dtype": params.obs_dtype,
             "calibrated": BULK_EVENTS is None
             or FULFILL_BULK is None
             or BULK_CYCLES is None,

@@ -40,7 +40,7 @@ from sparksched_tpu.trainers.rollout import (
     collect_sync,
     flat_micro_group_budget,
 )
-from sparksched_tpu.workload import make_workload_bank
+from sparksched_tpu.workload import bank_dtype_label, make_workload_bank
 
 TARGET = 50_000.0
 # stamp every row with engine-telemetry (micro-step composition,
@@ -115,6 +115,7 @@ def _inference_mem_stamp(params, bank, engine, steps, pol, bpol, knobs,
                     fulfill_bulk=knobs["fulfill_bulk"],
                     bulk_events=knobs["bulk_events"],
                     bulk_cycles=knobs["bulk_cycles"],
+                    bulk_fused=knobs["bulk_fused"],
                 )
             )(st_b, key)
 
@@ -144,6 +145,11 @@ def _flat_knobs() -> dict:
             os.environ.get("DEC_BENCH_FLAT_FULFILL", 1)
         )),
         "bulk_cycles": int(os.environ.get("DEC_BENCH_FLAT_CYCLES", 1)),
+        # ISSUE 7: single fused bulk kernel vs the pass pair (step-
+        # exact either way; purely a dispatch-count knob)
+        "bulk_fused": bool(int(
+            os.environ.get("DEC_BENCH_FLAT_FUSED", 1)
+        )),
     }
 
 
@@ -159,6 +165,7 @@ def _job_cap_candidates() -> list[int]:
 def bench_inference(
     num_envs: int = 64, steps: int = 512,
     compute_dtype: str | None = None, engine: str = "core",
+    bank_dtype: str | None = None,
 ) -> None:
     """Rollout-collection throughput (valid decision steps/s). `engine`
     selects the collector: "core" = per-decision `collect_sync` scan,
@@ -166,13 +173,21 @@ def bench_inference(
     decima_flat row; knobs from `_flat_knobs`), "fastpath" = the round-8
     single-eval batch collector (`collect_flat_sync_batch`: one batched
     GNN evaluation per decision row + active-job compaction, bucket K
-    calibrated over `BENCH_DECIMA_JOB_CAP` candidates)."""
+    calibrated over `BENCH_DECIMA_JOB_CAP` candidates).
+
+    `bank_dtype` (ISSUE 7) quantizes the workload bank's dur table
+    ("int16"/"int8"/"bf16") for the low-precision A/B row — the metric
+    name carries the layout tag and every row stamps `config.dtype`
+    with the bank's actual dur dtype, so the f32-vs-quantized sweep is
+    a recorded A/B, not a claim."""
     params = EnvParams(
         num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
         moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
         mean_time_limit=None,
     )
-    bank = make_workload_bank(params.num_executors, params.max_stages)
+    bank = make_workload_bank(
+        params.num_executors, params.max_stages, bank_dtype=bank_dtype
+    )
     if bank.max_stages != params.max_stages:
         params = params.replace(
             max_stages=bank.max_stages, max_levels=bank.max_stages
@@ -214,6 +229,7 @@ def bench_inference(
                     fulfill_bulk=knobs["fulfill_bulk"],
                     bulk_events=knobs["bulk_events"],
                     bulk_cycles=knobs["bulk_cycles"],
+                    bulk_fused=knobs["bulk_fused"],
                 )
                 return out if tm is not None else (out, None)
 
@@ -287,9 +303,16 @@ def bench_inference(
     value = total / dt
     tag = f"_{compute_dtype}" if compute_dtype else ""
     eng_tag = {"flat": "_flat", "fastpath": "_fastpath"}.get(engine, "")
+    # quantized-bank rows carry the layout in the metric name so the
+    # f32 row can never be overwritten/confused by the A/B partner
+    bank_tag = f"_bank{bank_dtype_label(bank)}" if bank_dtype else ""
     cfg = {
         "num_envs": num_envs,
         "engine": engine,
+        # ISSUE 7 layout stamp: the bank's ACTUAL dur dtype + the obs
+        # feature-bank dtype on every row
+        "dtype": bank_dtype_label(bank),
+        "obs_dtype": params.obs_dtype,
         # the compaction bucket this row ran with (0 = off) and the
         # calibration surface it was chosen from — part of EVERY row so
         # numbers are only compared at equal config
@@ -305,6 +328,7 @@ def bench_inference(
             "fulfill_bulk": knobs["fulfill_bulk"],
             "bulk_events": knobs["bulk_events"],
             "bulk_cycles": knobs["bulk_cycles"],
+            "bulk_fused": knobs["bulk_fused"],
         }
     if engine == "flat":
         cfg |= {"micro_per_decision": micro_per_dec} | knobs
@@ -317,7 +341,7 @@ def bench_inference(
         bpol_fit = None
     row = {
         "metric": f"decima_infer_steps_per_sec_{num_envs}envs{tag}"
-                  f"{eng_tag}",
+                  f"{eng_tag}{bank_tag}",
         "value": round(value, 1),
         "unit": "steps/s",
         "vs_baseline": round(value / TARGET, 3),
@@ -438,6 +462,8 @@ def bench_ppo(
             "num_envs": num_envs,
             "rollout_steps": rollout_steps,
             "engine": engine,
+            "dtype": bank_dtype_label(trainer.bank),
+            "obs_dtype": trainer.params_env.obs_dtype,
             "job_bucket": int(cfg_agent.get("job_bucket", 0)),
             "single_eval": bool(trainer.flat_single_eval),
             "prng_impl": str(jax.config.jax_default_prng_impl),
@@ -486,6 +512,14 @@ if __name__ == "__main__":
     bench_inference(
         num_envs=infer_envs, steps=infer_steps, compute_dtype="bfloat16",
         engine="fastpath",
+    )
+    # ISSUE 7 dtype sweep: the f32 fastpath row above vs the quantized
+    # (int16 dur table, per-template scale) bank on the SAME collector
+    # and knobs — the low-precision layout's throughput effect as a
+    # recorded A/B. DEC_BENCH_BANK_DTYPE overrides the swept layout.
+    bench_inference(
+        num_envs=infer_envs, steps=infer_steps, engine="fastpath",
+        bank_dtype=os.environ.get("DEC_BENCH_BANK_DTYPE", "int16"),
     )
     bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps)
     bench_ppo(

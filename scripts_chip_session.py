@@ -432,6 +432,75 @@ def stage_multichip_bench():
     print(f"[multichip] subprocess rc={r.returncode}", flush=True)
 
 
+def stage_fused_headline():
+    """ISSUE 7: the fused-engine 1024-lane headline row — bench.py
+    with the single fused bulk kernel (BENCH_BULK_FUSED=1, the
+    default) AND its unfused A/B partner at the SAME calibrated knobs,
+    on the real chip. Runs ENTIRELY in a subprocess, gate included
+    (counting devices claims the client); a chipless host prints an
+    explicit `[fused-headline] UNAVAILABLE` marker and exits 0 — the
+    watcher log must distinguish "no window" from "never ran". The
+    CPU A/B at the recorded CPU configs lives in PERF.md round 11;
+    this stage is the on-chip confirmation slot."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    if _client_held():
+        print("[fused-headline] parent process already holds a device "
+              "client; run stage 13 as its own invocation", flush=True)
+        return
+    repo = osp.dirname(osp.abspath(__file__))
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from sparksched_tpu.config import (\n"
+        "    enable_compilation_cache, honor_jax_platforms_env,\n"
+        "    use_fast_prng,\n"
+        ")\n"
+        "honor_jax_platforms_env()\n"
+        "enable_compilation_cache()\n"
+        "if os.environ.get('BENCH_PRNG', 'rbg') == 'rbg':\n"
+        "    use_fast_prng()\n"
+        "import jax\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    print('[fused-headline] UNAVAILABLE: cpu backend only; "
+        "the fused 1024-lane headline row needs a chip window (the "
+        "CPU fusion A/B is recorded in PERF.md round 11)', "
+        "flush=True)\n"
+        "    sys.exit(0)\n"
+        "import bench\n"
+        "bench.main()\n"
+    )
+    # fused run first (the headline row), then the unfused partner.
+    # Engine knobs are PINNED to the round-5 on-chip calibration
+    # (be=8 fb=1 bc=1) for BOTH arms: letting each run self-calibrate
+    # would let the pair drift apart in bulk knobs and the rows would
+    # no longer be the equal-config A/B this stage exists to record.
+    # The second run is best-effort (a closed window half-way still
+    # leaves the headline row).
+    for fused in ("1", "0"):
+        env = os.environ | {
+            "BENCH_BULK_FUSED": fused,
+            "BENCH_BULK_EVENTS": "8",
+            "BENCH_FULFILL_BULK": "1",
+            "BENCH_BULK_CYCLES": "1",
+            "BENCH_CPU_FALLBACK": "0",
+            "BENCH_WAIT_SECS": "120",
+        }
+        r = subprocess.run(
+            [sys.executable, "-c", code], cwd=repo, timeout=2700,
+            env=env,
+        )
+        print(
+            f"[fused-headline] bulk_fused={fused} subprocess "
+            f"rc={r.returncode}", flush=True,
+        )
+        if r.returncode != 0:
+            break
+
+
 STAGES = {
     "1": ("sanity", stage_sanity),
     "2": ("burst sweep", stage_sweep),
@@ -445,6 +514,7 @@ STAGES = {
     "10": ("static-analysis gate", stage_analysis),
     "11": ("on-chip memory capture", stage_memory_capture),
     "12": ("sharded multichip bench", stage_multichip_bench),
+    "13": ("fused-engine headline bench", stage_fused_headline),
 }
 
 
@@ -461,8 +531,8 @@ if __name__ == "__main__":
                 print("chip unavailable; aborting session", flush=True)
                 break
         finally:
-            # 7 and 12 run in subprocesses and 10 is
+            # 7, 12 and 13 run in subprocesses and 10 is
             # CPU-subprocess-only: none takes the in-process device
             # client
-            if p not in ("7", "10", "12"):
+            if p not in ("7", "10", "12", "13"):
                 _mark_client_held()

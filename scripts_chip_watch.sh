@@ -29,6 +29,8 @@ rm -f /tmp/headline_r05_remeasured
 rm -f /tmp/memcap_done
 # ... and for the sharded multichip bench (stage 12, ISSUE 6)
 rm -f /tmp/multichip_done
+# ... and for the fused-engine headline row (stage 13, ISSUE 7)
+rm -f /tmp/fused_headline_done
 # one-time legacy sweep: earlier-round trainers (tracked only by name,
 # pre-PID-file) must not survive into this watcher's lifetime — they
 # would contend the single core untracked and never be stopped for
@@ -181,6 +183,21 @@ print('ALIVE')
       # hour per loop and starve the flagship training stage below
       # (the log keeps the failing output for the round reader)
       touch "$MULTICHIP_MARK"
+    fi
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time fused-engine headline row (ISSUE 7, stage 13): the
+    # 1024-lane bench with the single fused bulk kernel plus its
+    # unfused equal-config partner — the on-chip confirmation of the
+    # CPU fusion A/B recorded in PERF.md round 11. Once per watcher
+    # lifetime; marked done only when a TPU-backed row landed (an
+    # UNAVAILABLE marker means no window yet — retry next loop).
+    FUSED_MARK=/tmp/fused_headline_done
+    if [ ! -f "$FUSED_MARK" ]; then
+      timeout -k 60 5500 python scripts_chip_session.py 13 \
+        | tee /tmp/fused_headline_last.log
+      echo "fused-headline rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q '"backend": "tpu"' /tmp/fused_headline_last.log \
+        && touch "$FUSED_MARK"
     fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable

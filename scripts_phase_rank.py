@@ -1,0 +1,117 @@
+"""Rank the decision-row phases of a telemetry-stamped bench run.
+
+ISSUE 7 evidence loop: `bench.py` / `bench_decima.py` rows carry an
+on-device `telemetry` summary whose `phase_iters` block (decide /
+fulfill / event / bulk — sparksched_tpu/obs/telemetry.py) splits the
+engine's while-loop iteration budget per phase. This script turns one
+or more recorded rows (JSON lines on stdin or in files, e.g. a saved
+BENCH_r*.json or a bench stdout capture) into a ranked markdown table
+of where the decision row spends its iterations — the measured input
+to "attack the top phase", replacing guesswork:
+
+  python bench.py | python scripts_phase_rank.py
+  python scripts_phase_rank.py artifacts/bench_tpu_r05_headline.json
+
+Per row the table ranks phases by iterations/decision and appends the
+drain-loop stats (`drain_iters_mean/max`, `drain_straggler_ratio` —
+the measured batch-max while tax of `drain_to_decision` /
+`_resume_simulation`) and the bulk-pass consumption ratio (events
+consumed by bulk passes per bulk iteration — the dispatch-fusion win
+`bulk_fused` exists to raise).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _rows(paths: list[str]):
+    streams = [open(p) for p in paths] if paths else [sys.stdin]
+    for fp in streams:
+        for line in fp:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "telemetry" in obj:
+                yield obj
+            elif isinstance(obj, dict):
+                # saved artifact files nest rows (e.g. MULTICHIP_r*)
+                for v in obj.values():
+                    if isinstance(v, dict) and "rows" in v:
+                        for r in v["rows"]:
+                            if isinstance(r, dict) and "telemetry" in r:
+                                yield r
+
+
+def phase_table(row: dict) -> str:
+    tm = row["telemetry"]
+    dec = max(int(tm.get("decisions", 0)), 1)
+    phases = tm.get("phase_iters")
+    if not phases:
+        return (
+            f"### {row.get('metric', '?')}\n"
+            "(no phase_iters block — re-run with a telemetry build "
+            "that carries the ISSUE-7 per-phase split)\n"
+        )
+    ranked = sorted(phases.items(), key=lambda kv: -kv[1])
+    total = sum(phases.values()) or 1
+    out = [
+        f"### {row.get('metric', '?')}  "
+        f"({row.get('value', '?')} {row.get('unit', '')}, backend "
+        f"{row.get('config', {}).get('backend', '?')}, dtype "
+        f"{row.get('config', {}).get('dtype', 'f32')}, fused "
+        f"{row.get('config', {}).get('bulk_fused', 'n/a')})",
+        "",
+        "| rank | phase | iters | iters/decision | share |",
+        "|---|---|---|---|---|",
+    ]
+    for i, (name, n) in enumerate(ranked, 1):
+        out.append(
+            f"| {i} | {name} | {n} | {n / dec:.2f} | "
+            f"{100.0 * n / total:.1f}% |"
+        )
+    bulk_ev = tm.get("bulk", {})
+    consumed = int(bulk_ev.get("relaunch_events", 0)) + int(
+        bulk_ev.get("ready_events", 0)
+    )
+    bulk_iters = max(int(phases.get("bulk", 0)), 1)
+    out += [
+        "",
+        f"- drain loop: mean {tm.get('drain_iters_mean', 'n/a')} / "
+        f"max {tm.get('drain_iters_max', 'n/a')} iters per lane, "
+        f"straggler ratio "
+        f"{tm.get('drain_straggler_ratio', 'n/a')} (batch-max tax)",
+        f"- bulk passes: {consumed} events over "
+        f"{phases.get('bulk', 0)} productive passes = "
+        f"{consumed / bulk_iters:.2f} events/pass",
+        f"- overall: {tm.get('loop_iters_mean', 'n/a')} mean loop "
+        f"iters/lane, straggler ratio "
+        f"{tm.get('straggler_ratio', 'n/a')}",
+        "",
+    ]
+    return "\n".join(out)
+
+
+def main(argv: list[str]) -> int:
+    n = 0
+    for row in _rows(argv):
+        print(phase_table(row))
+        n += 1
+    if n == 0:
+        print(
+            "# phase_rank: no telemetry-stamped rows found (pipe "
+            "bench.py/bench_decima.py output or name a saved row "
+            "file)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

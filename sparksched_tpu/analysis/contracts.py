@@ -119,6 +119,10 @@ MICRO_REC_SCHEMA: dict[str, tuple[str, tuple]] = {
 
 STORED_OBS_SCHEMA: dict[str, tuple[str, tuple]] = {
     "remaining": ("int32", ("J", "S")),
+    # the audited layout; `env: {obs_dtype: bfloat16}` configs narrow
+    # this leaf to bf16 (ISSUE 7) — the audit always runs the default
+    # f32 params, so the pin holds for CI while the low-precision
+    # layout stays an explicit per-config opt-in
     "duration": ("float32", ("J", "S")),
     "schedulable": ("bool", ("J", "S")),
     "node_mask": ("bool", ("J", "S")),

@@ -87,12 +87,20 @@ class Budget:
 # Pinned 2026-08 (jax 0.4.37, threefry, CPU trace) — measured eqns /
 # gathers / scatters: observe 78/0/0 (identical before and after the
 # implicit-dtype lint fixes, and to the tests/test_jaxpr_budget.py pin
-# this table absorbed), micro_step 4734/69/1, decide_micro_step
-# 2729/28/1, drain_to_decision 3374/45/1, decima_score 491/8/2,
-# decima_batch_policy 733/13/2, ppo_update 2856/43/3 (re-measured
-# 2860/43/3 after the ISSUE-6 fold_in minibatch-key derivation),
-# flat_collect_batch 13407/216/18 (ISSUE 6: 4 lanes x 3 decision
-# rows of the single-eval batch collector).
+# this table absorbed), decima_score 491/8/2, decima_batch_policy
+# 733/13/2, ppo_update 2856/43/3 (re-measured 2860/43/3 after the
+# ISSUE-6 fold_in minibatch-key derivation).
+#
+# Re-pinned 2026-08-03 for the ISSUE-7 fused bulk kernel
+# (core._bulk_events_fused replaces the relaunch+ready pass pair;
+# drain_to_decision additionally moved to the cheap existence-bit cond
+# + unmasked body): the fusion SHRANK the audited programs —
+# micro_step 4734/69/1 -> 4044/29/1, drain_to_decision 3374/45/1 ->
+# 2539/5/1, flat_collect_batch 13407/216/18 -> 12513/190/18;
+# decide_micro_step unchanged at 2729/28/1 (its bulk phase is the
+# mode-exclusive fulfill pass, deliberately left unfused). Caps below
+# tightened to ~1.35x the new measurements per the band policy; the
+# fusion A/B bench rows live in PERF.md round 11.
 # ---------------------------------------------------------------------------
 
 BUDGETS: dict[str, Budget] = {
@@ -103,19 +111,21 @@ BUDGETS: dict[str, Budget] = {
         eqn_lo=20, eqn_hi=110, gather_hi=2, scatter_hi=2, loop_free=True,
     ),
     # one flat micro-step at the shipped bulk config (be=8,
-    # fulfill_bulk, cycles=1) — the engine's unit of work (migrated;
-    # the scan is the bulk-relaunch cascade, not a decision loop)
+    # fulfill_bulk, cycles=1, fused bulk kernel) — the engine's unit
+    # of work (the scan is the fused event run, not a decision loop)
     "micro_step": Budget(
-        eqn_lo=2000, eqn_hi=6400, gather_hi=95, scatter_hi=3,
+        eqn_lo=2000, eqn_hi=5500, gather_hi=40, scatter_hi=3,
     ),
     # the single-eval collectors' policy-bearing micro-step
     "decide_micro_step": Budget(
         eqn_lo=1000, eqn_hi=3700, gather_hi=40, scatter_hi=3,
     ),
     # the single-eval collectors' non-policy drain (while-loop by
-    # design: it runs until the lane is ready to DECIDE again)
+    # design: it runs until the lane is ready to DECIDE again; the
+    # ISSUE-7 restructure keeps its cond to the event existence bit
+    # and drops the per-iteration full-pytree rollback select)
     "drain_to_decision": Budget(
-        eqn_lo=1500, eqn_hi=4600, gather_hi=65, scatter_hi=3,
+        eqn_lo=1200, eqn_hi=3450, gather_hi=8, scatter_hi=3,
     ),
     # Decima stage/exec scores over a [B]-stacked feature set, both
     # compaction branches under the scalar cond (the scan is the
@@ -139,7 +149,7 @@ BUDGETS: dict[str, Budget] = {
     # what makes this CPU audit valid for the sharded configuration;
     # the HLO-level collective census lives in tests/test_parallel.py.
     "flat_collect_batch": Budget(
-        eqn_lo=9000, eqn_hi=18100, gather_hi=292, scatter_hi=25,
+        eqn_lo=9000, eqn_hi=16900, gather_hi=257, scatter_hi=25,
     ),
 }
 

@@ -51,10 +51,13 @@ boundaries move; a band breach means structural allocation growth
 (a new lane-batched table, a widened buffer), not noise.
 
 Pinned 2026-08 (jax 0.4.37, threefry, CPU trace, tile-padded audit
-shapes) — measured temp-total MB: observe 2.3, micro_step 22.1,
-decide_micro_step 9.9, drain_to_decision 16.2, decima_score 153.6,
-decima_batch_policy 169.2, ppo_update 269.6, flat_collect_batch 357.7
-(ISSUE 6: 4-lane x 3-row single-eval batch collector). (The decima/ppo programs
+shapes) — measured temp-total MB: observe 2.3, decima_score 153.6,
+decima_batch_policy 169.2, ppo_update 269.6. Re-pinned 2026-08-03
+for the ISSUE-7 fused bulk kernel, which SHRANK the engine programs:
+micro_step 22.1 -> 16.1, drain_to_decision 16.2 -> 9.7,
+flat_collect_batch 357.7 -> 329.8; decide_micro_step unchanged at
+9.9 (its bulk phase is the mode-exclusive fulfill pass, deliberately
+unfused). (The decima/ppo programs
 carry a 4-lane batch in their audited shapes, and tile padding
 inflates narrow minor dims — these are model numbers for regression
 detection, not literal HBM footprints; the lane-fit table is the
@@ -116,9 +119,9 @@ MB = 10**6
 
 MEM_BUDGETS: dict[str, MemBudget] = {
     "observe": MemBudget(temp_hi=4 * MB),
-    "micro_step": MemBudget(temp_hi=30 * MB),
+    "micro_step": MemBudget(temp_hi=22 * MB),
     "decide_micro_step": MemBudget(temp_hi=14 * MB),
-    "drain_to_decision": MemBudget(temp_hi=22 * MB),
+    "drain_to_decision": MemBudget(temp_hi=14 * MB),
     "decima_score": MemBudget(temp_hi=210 * MB),
     "decima_batch_policy": MemBudget(temp_hi=230 * MB),
     "ppo_update": MemBudget(temp_hi=365 * MB),
@@ -127,7 +130,7 @@ MEM_BUDGETS: dict[str, MemBudget] = {
     # under a dp mesh each device holds a 1/dp shard of every
     # lane-batched buffer, which is what the lane-fit advisor's `mesh`
     # mode models — these bytes bound the unsharded audit program)
-    "flat_collect_batch": MemBudget(temp_hi=485 * MB),
+    "flat_collect_batch": MemBudget(temp_hi=445 * MB),
 }
 
 # lane counts the advisor sweeps (the bench's production range; 1024

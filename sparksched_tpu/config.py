@@ -63,6 +63,29 @@ class EnvParams:
     # (reference components/executor.py:20-26). 0 disables.
     history_cap: int = 0
 
+    # dtype of the observation feature bank (`Observation.nodes` and
+    # the recorded per-decision `StoredObs.duration` buffers):
+    # "float32" (default) or "bfloat16" (ISSUE 7 low-precision
+    # observation layout — halves the lane-scaled rollout-obs bytes;
+    # consumers accumulate in f32, drift pinned by the observe-path
+    # epsilon test). Env dynamics and rewards are f32 either way.
+    # Aliases f32/bf16 normalize; anything else raises — the layout
+    # checks compare the exact canonical string, and a misspelled
+    # value silently running f32 would stamp mislabeled bench rows.
+    obs_dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        canon = {
+            "float32": "float32", "f32": "float32",
+            "bfloat16": "bfloat16", "bf16": "bfloat16",
+        }.get(self.obs_dtype)
+        if canon is None:
+            raise ValueError(
+                f"obs_dtype {self.obs_dtype!r} is not one of "
+                "float32/f32/bfloat16/bf16"
+            )
+        object.__setattr__(self, "obs_dtype", canon)
+
     @property
     def num_nodes(self) -> int:
         return self.max_jobs * self.max_stages
@@ -82,7 +105,7 @@ def env_params_from_cfg(env_cfg: dict[str, Any]) -> EnvParams:
     for k, v in env_cfg.items():
         if k not in types:
             continue
-        if v is not None:
+        if v is not None and types[k] != "str":
             v = int(float(v)) if types[k] == "int" else float(v)
         kw[k] = v
     if "max_jobs" not in kw and "job_arrival_cap" in env_cfg:

@@ -996,6 +996,19 @@ def _next_event(params: EnvParams, state: EnvState):
     return has, tmin, kind, arg
 
 
+def _has_pending_event(state: EnvState) -> jnp.ndarray:
+    """Cheap existence bit of `_next_event` — drain/resume loop conds
+    need only "is anything pending", not the (kind, arg) argmin chain
+    (the ISSUE-7 cheap-cond restructure)."""
+    t = jnp.minimum(
+        jnp.where(state.job_arrived, INF, state.job_arrival_time).min(),
+        jnp.minimum(
+            state.exec_finish_time.min(), state.exec_arrive_time.min()
+        ),
+    )
+    return jnp.isfinite(t)
+
+
 def _rank_order(key: jnp.ndarray) -> jnp.ndarray:
     """Stable ascending order of `key` as an index array — the
     `jnp.argsort(..., stable=True)` contract (ties break by index) —
@@ -1462,6 +1475,269 @@ def _bulk_ready(
     return state, k
 
 
+def _bulk_events_fused(
+    params: EnvParams, bank: WorkloadBank, state: EnvState,
+    enabled: jnp.ndarray, stop_at_limit: bool = False,
+    max_events: int = 8,
+):
+    """Consume one maximal run of *simple* events — task relaunches AND
+    executor arrivals, interleaved in exact (time, seq) order — in a
+    SINGLE bounded scan. Returns (state, k_rel, k_rdy): events consumed
+    by kind (both 0 when the next event is not simple, the queue is
+    drained, or `enabled` is False).
+
+    This fuses `_bulk_relaunch` + `_bulk_ready` into one kernel (ISSUE
+    7): instead of a fixed relaunch-pass / arrival-pass order — which
+    pays one micro-step per event-kind switch and two full pass-sized
+    op chains per micro-step — every scan step picks the lexicographic
+    (time, seq) minimum over ALL pending finishes and arrivals,
+    classifies it against the live remaining-task view, and applies it.
+    One rng split, one duration-sampling chain per consumed event, one
+    merged `state.replace` at the end. Because events are processed in
+    true queue order, the separate passes' cross-kind stop conditions
+    (`_bulk_ready`'s generated-finish cutoff, `_bulk_relaunch` treating
+    arrivals as competitors) dissolve: a finish event generated by an
+    in-run arrival start simply participates in later steps, and mixed
+    relaunch/arrival runs that previously cost one micro-step per kind
+    switch are consumed in one pass.
+
+    An event is *simple* iff its target stage still has unlaunched
+    tasks at its turn (`rem > 0` on the live view):
+
+    - a TASK_FINISHED on a stage with `rem > 0` relaunches (the
+      `_handle_task_finished` more_tasks path resolving to A_START);
+      `rem == 0` means the released-stage handler must run — stop;
+    - an EXECUTOR_READY whose destination has `rem > 0` resolves
+      locally to A_START (destination on the frontier — static during
+      the run, no stage ever completes here) or A_PARK; `rem == 0`
+      triggers the backup-stage search — stop.
+
+    The run also stops before any job-arrival competitor, right after
+    an arrival that joins the live source pool (it can raise
+    `num_committable` above 0, and the sequential per-event tail must
+    run before the next event), and — with `stop_at_limit` — right
+    after the first event at or past the episode time limit.
+
+    The fulfillment-phase bulk (`_bulk_fulfill`) stays a separate pass
+    in the shared micro-step tail: fulfillment work only exists on
+    DECIDE-mode lanes and event work only on EVENT-mode lanes, so the
+    two passes are mode-exclusive per micro-step and fusing them would
+    add op count without removing a dispatch.
+
+    Cross-event coupling is tracked in the scan carry: the live
+    per-stage remaining view `rem[J,S]` (launches of either kind
+    decrement it), the live executors-per-job count (`jcnt[J]` — the
+    duration model's executor-level input; arrivals attach mid-run),
+    and each executor's CURRENT finish-event stage (`fj`/`fs` — an
+    arrival start re-targets the executor's next finish to its
+    destination stage, and that finish may itself relaunch within the
+    same pass). The scan length is `max_events + N`: the budget of one
+    full relaunch cascade plus a worst-case arrival burst, so a fused
+    pass can always consume at least what the unfused pass pair could.
+
+    Matches the sequential path bit-exactly except the rng stream
+    (one batched uniform table, as in the unfused passes)."""
+    n = state.exec_job.shape[0]
+    j_cap, s_cap = state.stage_remaining.shape
+    pos = jnp.arange(n, dtype=_i32)
+    length = max_events + n
+
+    # job arrivals: the only competitor kind (never consumed here)
+    t_job = jnp.where(state.job_arrived, INF, state.job_arrival_time)
+    jt = t_job.min()
+    jseq = jnp.where(t_job == jt, state.job_arrival_seq, BIG_SEQ).min()
+
+    # static per-executor arrival facts (an arrival's destination and
+    # wave inputs cannot change before it fires — the executor is
+    # moving, so no other event touches it first)
+    dj = state.exec_dst_job
+    ds0 = state.exec_dst_stage
+    djc = jnp.clip(dj, 0, j_cap - 1)
+    dsc = jnp.clip(ds0, 0, s_cap - 1)
+    frontier_a = state.frontier[djc, dsc]
+    tv_a = state.exec_task_valid
+    ss_a = state.exec_task_stage == ds0
+    sq_a = state.exec_arrive_seq
+    joins_a = (
+        state.source_valid
+        & (dj == state.source_job)
+        & jnp.where(
+            frontier_a, ds0 == state.source_stage,
+            state.source_stage == -1,
+        )
+    )
+
+    rng_next, sub = jax.random.split(state.rng)
+    # one batched draw for the whole pass; us[i, e] is consumed iff the
+    # i-th processed event belongs to executor e (selection at step i
+    # depends only on earlier draws, so consumed draws are i.i.d.)
+    us = jax.random.uniform(sub, (length, n, 2))
+
+    jcnt0 = (
+        state.exec_job[None, :] == jnp.arange(j_cap, dtype=_i32)[:, None]
+    ).sum(-1).astype(_i32)
+
+    def pick_i(oh, x):
+        return jnp.where(oh, x, 0).sum().astype(x.dtype)
+
+    def step_fn(carry, u_row):
+        (t_f, sq_f, t_a, fj, fs, rem, jcnt, launch_t, dur_js, relc,
+         arr_done, started, counter, wall, active, crossed) = carry
+
+        # lexicographic (time, seq) minimum over finishes and arrivals
+        ftmin = t_f.min()
+        fcand = t_f == ftmin
+        fsmin = jnp.where(fcand, sq_f, BIG_SEQ).min()
+        atmin = t_a.min()
+        acand = t_a == atmin
+        asmin = jnp.where(acand, sq_a, BIG_SEQ).min()
+        is_fin = (ftmin < atmin) | ((ftmin == atmin) & (fsmin < asmin))
+        tmin = jnp.minimum(ftmin, atmin)
+        smin = jnp.where(is_fin, fsmin, asmin)
+        has = jnp.isfinite(tmin)
+        before_job = (tmin < jt) | ((tmin == jt) & (smin < jseq))
+        e_oh = jnp.where(
+            is_fin, fcand & (sq_f == fsmin), acand & (sq_a == asmin)
+        )
+
+        # the winner's target stage on the LIVE views
+        tj = jnp.where(is_fin, pick_i(e_oh, fj), pick_i(e_oh, djc))
+        ts = jnp.where(is_fin, pick_i(e_oh, fs), pick_i(e_oh, dsc))
+        rem_t = rem[tj, ts]
+        ok = active & has & before_job & (rem_t > 0)
+        if stop_at_limit:
+            ok = ok & ~crossed
+            crossed = crossed | (ok & (tmin >= state.time_limit))
+        start_a = (e_oh & frontier_a).any()  # arrival-start vs park
+        is_rel = ok & is_fin
+        is_arr = ok & ~is_fin
+        launch = is_rel | (is_arr & start_a)
+        # an arrival that joins the live source pool ends the run
+        # AFTER being consumed (the caller's tail then runs exactly
+        # where the sequential loop's would)
+        joins = is_arr & (e_oh & joins_a).any()
+
+        # duration for the launched task (relaunch: same-stage
+        # continuation; arrival: the sequential wave inputs)
+        u2 = jnp.where(e_oh[:, None], u_row, 0.0).sum(0)
+        nl = jcnt[tj] + is_arr.astype(_i32)  # arrival counts itself
+        tv = jnp.where(is_fin, True, (e_oh & tv_a).any())
+        ss = jnp.where(is_fin, True, (e_oh & ss_a).any())
+        dur = sample_task_duration(
+            params, bank, u2, state.job_template[tj], ts, nl, tv, ss
+        )
+
+        oh2 = _onehot2(j_cap, s_cap, tj, ts)
+        t_f = jnp.where(launch & e_oh, tmin + dur, t_f)
+        sq_f = jnp.where(launch & e_oh, counter, sq_f)
+        t_a = jnp.where(is_arr & e_oh, INF, t_a)
+        fj = jnp.where(is_arr & start_a & e_oh, tj, fj)
+        fs = jnp.where(is_arr & start_a & e_oh, ts, fs)
+        rem = rem - (launch & oh2).astype(_i32)
+        jcnt = jcnt + (is_arr & _onehot(j_cap, tj)).astype(_i32)
+        launch_t = launch_t | (launch & oh2)
+        dur_js = jnp.where(launch & oh2, dur, dur_js)
+        relc = relc + (is_rel & oh2).astype(_i32)
+        arr_done = arr_done | (is_arr & e_oh)
+        started = started | (is_arr & start_a & e_oh)
+        counter = counter + launch.astype(_i32)
+        wall = jnp.where(ok, tmin, wall)
+        active = active & ok & ~joins
+        return (
+            t_f, sq_f, t_a, fj, fs, rem, jcnt, launch_t, dur_js, relc,
+            arr_done, started, counter, wall, active, crossed,
+        ), None
+
+    jc = jnp.clip(state.exec_job, 0, j_cap - 1)
+    sc = jnp.clip(state.exec_task_stage, 0, s_cap - 1)
+    carry0 = (
+        state.exec_finish_time,
+        state.exec_finish_seq,
+        state.exec_arrive_time,
+        jc,
+        sc,
+        state.stage_remaining,
+        jcnt0,
+        jnp.zeros((j_cap, s_cap), bool),
+        jnp.zeros((j_cap, s_cap), jnp.float32),
+        jnp.zeros((j_cap, s_cap), _i32),
+        jnp.zeros(n, bool),
+        jnp.zeros(n, bool),
+        state.seq_counter,
+        state.wall_time,
+        jnp.asarray(enabled, bool),
+        jnp.bool_(False),
+    )
+    (t_f, sq_f, t_a, _, _, rem, _, launch_t, dur_js, relc, arr_done,
+     started, counter, wall, _, _), _ = lax.scan(step_fn, carry0, us)
+
+    k_rel = relc.sum()
+    k_rdy = arr_done.sum().astype(_i32)
+    bulked = (k_rel + k_rdy) > 0
+
+    # [J,S] scatters for the consumed arrivals (static destinations)
+    oh_j = (dj[:, None] == jnp.arange(j_cap, dtype=_i32)[None, :]) \
+        & arr_done[:, None]
+    oh_s = ds0[:, None] == jnp.arange(s_cap, dtype=_i32)[None, :]
+    m3 = oh_j[:, :, None] & oh_s[:, None, :]
+    cnt_arr = m3.sum(0).astype(_i32)
+    cnt_start = (m3 & started[:, None, None]).sum(0).astype(_i32)
+    moving_count = state.moving_count - cnt_arr
+    stage_executing = state.stage_executing + cnt_start
+
+    # stages that launched down to zero transitioned to fully-launched
+    # (launches are the only in-run decrements and require rem > 0)
+    newly_exh = launch_t & (rem == 0)
+    job_saturated_stages = (
+        state.job_saturated_stages + newly_exh.sum(-1).astype(_i32)
+    )
+
+    # saturation-cache refresh over every touched stage, full-array
+    # form: demand moved wherever a launch or an arrival landed
+    touched = launch_t | (cnt_arr > 0)
+    demand = rem - moving_count - state.commit_count
+    sat_new = demand <= 0
+    delta = jnp.where(
+        touched & state.stage_exists,
+        sat_new.astype(_i32) - state.stage_sat.astype(_i32),
+        0,
+    )
+    unsat = state.unsat_parent_count - jnp.einsum(
+        "jp,jpc->jc", delta, state.adj.astype(_i32)
+    )
+
+    state = state.replace(
+        rng=jnp.where(bulked, rng_next, state.rng),
+        wall_time=wall,
+        seq_counter=counter,
+        exec_finish_time=t_f,
+        exec_finish_seq=sq_f,
+        exec_arrive_time=t_a,
+        exec_moving=state.exec_moving & ~arr_done,
+        exec_at_common=state.exec_at_common & ~arr_done,
+        exec_job=jnp.where(arr_done, dj, state.exec_job),
+        exec_stage=jnp.where(
+            arr_done, jnp.where(started, ds0, -1), state.exec_stage
+        ),
+        exec_task_valid=jnp.where(
+            arr_done, started, state.exec_task_valid
+        ),
+        exec_executing=state.exec_executing | started,
+        exec_task_stage=jnp.where(
+            started, ds0, state.exec_task_stage
+        ),
+        stage_remaining=rem,
+        stage_completed_tasks=state.stage_completed_tasks + relc,
+        stage_executing=stage_executing,
+        moving_count=moving_count,
+        stage_duration=jnp.where(launch_t, dur_js, state.stage_duration),
+        job_saturated_stages=job_saturated_stages,
+        stage_sat=jnp.where(touched, sat_new, state.stage_sat),
+        unsat_parent_count=unsat,
+    )
+    return state, k_rel, k_rdy
+
+
 def _resume_simulation(
     params: EnvParams, bank: WorkloadBank, state: EnvState,
     active: jnp.ndarray, bulk: bool = True, bulk_events: int = 8,
@@ -1506,7 +1782,8 @@ def _resume_simulation(
             single = ((nb1 + nb2) == 0) | (st.num_committable() == 0)
             if track:
                 tm = _tm_add(
-                    tm, bulk_relaunch_events=nb1, bulk_ready_events=nb2
+                    tm, bulk_relaunch_events=nb1, bulk_ready_events=nb2,
+                    bulk_passes=(nb1 + nb2) > 0,
                 )
         else:
             single = jnp.bool_(True)
@@ -1518,6 +1795,7 @@ def _resume_simulation(
             tm = _tm_add(
                 tm,
                 loop_iters=1,
+                drain_iters=1,
                 event_steps=did_pop,
                 ev_job_arrival=did_pop & (kind == EV_JOB_ARRIVAL),
                 ev_task_finished=did_pop & (kind == EV_TASK_FINISHED),

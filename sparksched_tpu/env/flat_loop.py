@@ -44,6 +44,7 @@ from .core import (
     _onehot2,
     _add_commitment,
     _apply_action,
+    _bulk_events_fused,
     _bulk_fulfill,
     _bulk_ready,
     _bulk_relaunch,
@@ -52,6 +53,7 @@ from .core import (
     _handle_executor_ready,
     _handle_job_arrival,
     _handle_task_finished,
+    _has_pending_event,
     _move_idle_from_pool,
     _next_event,
     _resolve_action,
@@ -175,17 +177,22 @@ def _bulk_cycle_chain(
     is_event: jnp.ndarray,
     bulk_events: int,
     bulk_cycles: int,
+    bulk_fused: bool = True,
 ):
-    """`bulk_cycles` chained (relaunch cascade + arrival burst) pass
-    pairs. The first pair runs whenever the lane is in EVENT mode (the
-    round-3 behavior); each further pair runs only while the sequential
-    between-event tail would be a no-op — `num_committable() == 0`
-    (round-ready flip and move_and_clear are gated on committable > 0)
-    and the wall clock inside the episode limit (the freeze point) — so
-    chaining is exactly the next micro-step's bulk phase minus its
-    provably-no-op tail. Returns
-    (env, events_consumed, relaunch_events, ready_events) — the last
-    two split the count by pass kind for the telemetry counters."""
+    """`bulk_cycles` chained bulk passes. With `bulk_fused` (the ISSUE-7
+    default) each cycle is ONE `core._bulk_events_fused` kernel that
+    consumes a mixed relaunch/arrival run in exact (time, seq) order —
+    one scan, one rng split, one merged state update per cycle; without
+    it, each cycle is the round-3/4 (relaunch cascade + arrival burst)
+    pass pair. The first cycle runs whenever the lane is in EVENT mode;
+    each further cycle runs only while the sequential between-event
+    tail would be a no-op — `num_committable() == 0` (round-ready flip
+    and move_and_clear are gated on committable > 0) and the wall clock
+    inside the episode limit (the freeze point) — so chaining is
+    exactly the next micro-step's bulk phase minus its provably-no-op
+    tail. Returns (env, events_consumed, relaunch_events, ready_events)
+    — the last two split the count by event kind for the telemetry
+    counters."""
     nb = _i32(0)
     nb_rel = _i32(0)
     nb_rdy = _i32(0)
@@ -195,17 +202,23 @@ def _bulk_cycle_chain(
             & (env.num_committable() == 0)
             & (env.wall_time < env.time_limit)
         )
-        env, nbi1 = _bulk_relaunch(
-            params, bank, env, on,
-            stop_at_limit=True, max_events=bulk_events,
-        )
-        # chain the arrival-burst pass; never past an episode-limit
-        # crossing the cascade just committed (the freeze point)
-        env, nbi2 = _bulk_ready(
-            params, bank, env,
-            on & (env.wall_time < env.time_limit),
-            stop_at_limit=True,
-        )
+        if bulk_fused:
+            env, nbi1, nbi2 = _bulk_events_fused(
+                params, bank, env, on,
+                stop_at_limit=True, max_events=bulk_events,
+            )
+        else:
+            env, nbi1 = _bulk_relaunch(
+                params, bank, env, on,
+                stop_at_limit=True, max_events=bulk_events,
+            )
+            # chain the arrival-burst pass; never past an episode-limit
+            # crossing the cascade just committed (the freeze point)
+            env, nbi2 = _bulk_ready(
+                params, bank, env,
+                on & (env.wall_time < env.time_limit),
+                stop_at_limit=True,
+            )
         nb = nb + nbi1 + nbi2
         nb_rel = nb_rel + nbi1
         nb_rdy = nb_rdy + nbi2
@@ -388,6 +401,7 @@ def micro_step(
     reset_fn: Callable | None = None,
     t_ref: jnp.ndarray | None = None,
     telemetry=None,
+    bulk_fused: bool = True,
 ) -> LoopState | tuple:
     """One unit of work for one lane (vmap over lanes). With
     `event_bulk`, an EVENT micro-step consumes a whole run of relaunch
@@ -428,6 +442,12 @@ def micro_step(
     (the wall time of the round-finishing decision; only read when
     `params.beta > 0`).
 
+    With `bulk_fused` (the ISSUE-7 default), the bulk phase is the
+    single fused `core._bulk_events_fused` kernel — mixed
+    relaunch/arrival runs in exact queue order, one pass — instead of
+    the (relaunch cascade + arrival burst) pass pair; step-exact
+    either way (tests/test_flat_loop.py pins fused vs unfused).
+
     With `telemetry` (an `obs.Telemetry`, static None check), the
     counters are advanced on live lanes — micro-step composition by
     entry mode, events consumed (`loop_iters`), pops by kind, bulk-pass
@@ -439,7 +459,7 @@ def micro_step(
     if event_bulk:
         env_b, nb, nb_rel, nb_rdy = _bulk_cycle_chain(
             params, bank, ls.env, ls.mode == M_EVENT, bulk_events,
-            bulk_cycles,
+            bulk_cycles, bulk_fused,
         )
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
     else:
@@ -510,6 +530,7 @@ def micro_step(
             loop_iters=jnp.where(live, nb + popped.astype(_i32), 0),
             bulk_relaunch_events=jnp.where(live, nb_rel, 0),
             bulk_ready_events=jnp.where(live, nb_rdy, 0),
+            bulk_passes=(nb > 0) & live,
             ev_job_arrival=pop_live & (ev_kind == EV_JOB_ARRIVAL),
             ev_task_finished=pop_live & (ev_kind == EV_TASK_FINISHED),
             ev_exec_ready=pop_live & (ev_kind == EV_EXECUTOR_READY),
@@ -697,6 +718,7 @@ def event_micro_step(
     reset_fn: Callable | None = None,
     t_ref: jnp.ndarray | None = None,
     telemetry=None,
+    bulk_fused: bool = True,
 ) -> LoopState | tuple:
     """One EVENT-only micro-step: lanes in M_EVENT mode pop + handle one
     event (with the full shared tail); other lanes no-op. With `record`,
@@ -721,6 +743,7 @@ def event_micro_step(
     if event_bulk:
         env_b, nb, nb_rel, nb_rdy = _bulk_cycle_chain(
             params, bank, ls.env, is_event, bulk_events, bulk_cycles,
+            bulk_fused,
         )
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
         pop_on = is_event & _fused_pop_gate(env_b, nb)
@@ -749,6 +772,7 @@ def event_micro_step(
             loop_iters=jnp.where(gate, nb + popped.astype(_i32), 0),
             bulk_relaunch_events=jnp.where(gate, nb_rel, 0),
             bulk_ready_events=jnp.where(gate, nb_rdy, 0),
+            bulk_passes=(nb > 0) & gate,
             ev_job_arrival=pop_live & (ev_kind == EV_JOB_ARRIVAL),
             ev_task_finished=pop_live & (ev_kind == EV_TASK_FINISHED),
             ev_exec_ready=pop_live & (ev_kind == EV_EXECUTOR_READY),
@@ -843,13 +867,24 @@ def drain_micro_step(
     reset_fn: Callable | None = None,
     t_ref: jnp.ndarray | None = None,
     telemetry=None,
+    bulk_fused: bool = True,
+    masked: bool = True,
 ) -> tuple:
     """One NON-POLICY micro-step: FULFILL and EVENT lanes advance exactly
     as `micro_step`'s branches (bulk passes + fused pop included); DECIDE
     lanes no-op bit-exactly. Contains no observe/policy ops at all — the
     point of the single-eval restructure is that this program, not the
     policy-bearing one, runs between decisions. Returns
-    `(ls, (reward, dt, reset)[, telemetry])`."""
+    `(ls, (reward, dt, reset)[, telemetry])`.
+
+    `masked=False` skips the final full-pytree select that rolls
+    DECIDE-mode lanes back — legal ONLY when the caller already
+    guarantees every lane that reaches this step is non-DECIDE, which
+    is exactly `drain_to_decision`'s while body: the vmapped
+    while-loop's batching rule selects the whole carry against each
+    lane's own cond, so the per-iteration ~50-leaf select here (adj is
+    [J,S,S] per lane) was pure duplicated bandwidth on the drain's hot
+    path (ISSUE 7 drain restructure)."""
     track = telemetry is not None
     active = ls.mode != M_DECIDE
     _, k_reset = jax.random.split(rng)
@@ -857,7 +892,7 @@ def drain_micro_step(
     if event_bulk:
         env_b, nb, nb_rel, nb_rdy = _bulk_cycle_chain(
             params, bank, ls.env, ls.mode == M_EVENT, bulk_events,
-            bulk_cycles,
+            bulk_cycles, bulk_fused,
         )
         ls = ls.replace(env=env_b, bulked=ls.bulked + nb)
     else:
@@ -893,10 +928,16 @@ def drain_micro_step(
             loop_iters=jnp.where(gate, nb + popped.astype(_i32), 0),
             bulk_relaunch_events=jnp.where(gate, nb_rel, 0),
             bulk_ready_events=jnp.where(gate, nb_rdy, 0),
+            bulk_passes=(nb > 0) & gate,
             ev_job_arrival=pop_live & (ev_kind == EV_JOB_ARRIVAL),
             ev_task_finished=pop_live & (ev_kind == EV_TASK_FINISHED),
             ev_exec_ready=pop_live & (ev_kind == EV_EXECUTOR_READY),
         )
+    if not masked:
+        # drain-while body: the loop's own batched-cond carry select
+        # already discards DECIDE lanes' outputs
+        rec = (rw, dt, rs_)
+        return (out_ls, rec, telemetry) if track else (out_ls, rec)
     final = jax.tree_util.tree_map(
         lambda a, b: jnp.where(active, a, b), out_ls, ls0
     )
@@ -921,6 +962,7 @@ def drain_to_decision(
     reset_fn: Callable | None = None,
     t_ref: jnp.ndarray | None = None,
     telemetry=None,
+    bulk_fused: bool = True,
 ) -> tuple:
     """Drain one lane's non-decision work — FULFILL leftovers and the
     whole inter-decision event run — until it is ready to DECIDE again
@@ -932,13 +974,20 @@ def drain_to_decision(
     pure env machinery (bulk passes + single pops), so the straggler tax
     lands on the cheap slice while the GNN, the decision row's measured
     70-90% share, runs exactly once per decision outside this loop.
-    Returns `(ls, (reward, dt, reset)[, telemetry])`."""
+    The ISSUE-7 restructure keeps that slice cheap two ways: the cond
+    reduces to the existence bit of the next event (`_has_pending_event`
+    — no argmin/kind chain), and the body runs `drain_micro_step` with
+    `masked=False`, relying on the batched while-loop's own per-lane
+    carry select instead of re-selecting the ~50-leaf LoopState every
+    iteration. The per-lane iteration count is measured directly
+    (`drain_iters` — its max/mean over lanes IS the drain's batch-max
+    while tax). Returns `(ls, (reward, dt, reset)[, telemetry])`."""
     track = telemetry is not None
     zero = jnp.float32(0.0)
 
     def cond(c):
         ls = c[0]
-        has, _, _, _ = _next_event(params, ls.env)
+        has = _has_pending_event(ls.env)
         # a drained queue with the episode still open cannot progress
         # without a new decision round — hand such a lane back to the
         # caller instead of spinning forever
@@ -948,12 +997,14 @@ def drain_to_decision(
     def body(c):
         if track:
             ls, k, rw, dt, rs, tm = c
+            tm = _tm_add(tm, drain_iters=1)
         else:
             (ls, k, rw, dt, rs), tm = c, None
         k, sub = jax.random.split(k)
         out = drain_micro_step(
             params, bank, ls, sub, auto_reset, event_bulk, bulk_events,
             bulk_cycles, reset_fn, t_ref, telemetry=tm,
+            bulk_fused=bulk_fused, masked=False,
         )
         if track:
             ls, (r, d, re), tm = out
@@ -988,6 +1039,7 @@ def run_flat(
     bulk_cycles: int = 1,
     loop_state: LoopState | None = None,
     telemetry=None,
+    bulk_fused: bool = True,
 ) -> LoopState | tuple:
     """Scan `num_groups` micro-step groups for one lane (vmap over
     lanes). Each group is one full micro-step plus `event_burst - 1`
@@ -1009,7 +1061,7 @@ def run_flat(
         out = micro_step(
             params, bank, policy_fn, ls, sub, auto_reset,
             compute_levels, event_bulk, bulk_events, fulfill_bulk,
-            bulk_cycles, telemetry=tm,
+            bulk_cycles, telemetry=tm, bulk_fused=bulk_fused,
         )
         ls, tm = out if track else (out, None)
         for _ in range(event_burst - 1):
@@ -1017,6 +1069,7 @@ def run_flat(
             out = event_micro_step(
                 params, bank, ls, sub, auto_reset, event_bulk,
                 bulk_events, bulk_cycles, telemetry=tm,
+                bulk_fused=bulk_fused,
             )
             ls, tm = out if track else (out, None)
         return ((ls, k, tm) if track else (ls, k)), None

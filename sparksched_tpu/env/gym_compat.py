@@ -35,7 +35,10 @@ def compact_obs(params: EnvParams, obs: Observation) -> dict[str, Any]:
     """Convert a padded Observation into the reference's ragged obs dict."""
     node_mask = np.asarray(obs.node_mask)
     job_mask = np.asarray(obs.job_mask)
-    nodes_padded = np.asarray(obs.nodes)
+    # f32 at the host boundary: the reference obs dict is float32, and
+    # a bf16 observation bank (params.obs_dtype) must not leak an
+    # ml_dtypes array into gym consumers
+    nodes_padded = np.asarray(obs.nodes, dtype=np.float32)
     adj = np.asarray(obs.adj)
     supplies = np.asarray(obs.exec_supplies)
 
@@ -126,7 +129,8 @@ class SparkSchedSimGymEnv(gym.Env if _GYM else object):
         self.bank = bank if bank is not None else make_workload_bank(
             self.params.num_executors, self.params.max_stages,
             **{k: v for k, v in env_cfg.items()
-               if k in ("data_dir", "seed", "bucket_size")},
+               if k in ("data_dir", "seed", "bucket_size",
+                        "bank_dtype")},
         )
         if self.bank.max_stages != self.params.max_stages:
             # real traces may exceed the configured cap; the bank widens and
@@ -197,7 +201,8 @@ class SparkSchedSimVectorEnv:
         self.bank = bank if bank is not None else make_workload_bank(
             self.params.num_executors, self.params.max_stages,
             **{k: v for k, v in env_cfg.items()
-               if k in ("data_dir", "seed", "bucket_size")},
+               if k in ("data_dir", "seed", "bucket_size",
+                        "bank_dtype")},
         )
         if self.bank.max_stages != self.params.max_stages:
             self.params = self.params.replace(

@@ -25,7 +25,7 @@ class Observation(struct.PyTreeNode):
     padded. `nodes[..., :]` = (num_remaining_tasks, most_recent_duration,
     is_schedulable) exactly as the reference's 3 node features."""
 
-    nodes: jnp.ndarray  # f32[J,S,3]
+    nodes: jnp.ndarray  # f32[J,S,3] (bf16 under params.obs_dtype)
     node_mask: jnp.ndarray  # bool[J,S]; active stages of active jobs
     job_mask: jnp.ndarray  # bool[J]; active jobs
     schedulable: jnp.ndarray  # bool[J,S]
@@ -57,7 +57,15 @@ def observe(
     expensive part of an observation (`core.compute_node_levels` remains
     as the golden recomputation, parity-pinned in
     tests/test_incremental_caches.py). `compute_levels=False` fills the
-    padding value instead; only the Decima GNN reads `node_level`."""
+    padding value instead; only the Decima GNN reads `node_level`.
+
+    `params.obs_dtype = "bfloat16"` (ISSUE 7 low-precision observation
+    layout) narrows the feature bank `nodes` — and therefore the
+    recorded per-decision `StoredObs.duration` buffers that inherit its
+    dtype — to bf16; every consumer (`build_features`, the stored-obs
+    rebuild) upcasts to f32 at its read site, so accumulations stay
+    f32 and the drift is bounded by one bf16 rounding of each raw
+    feature (pinned by the observe-path epsilon test)."""
     job_mask = state.job_active
     node_mask = (
         job_mask[:, None] & state.stage_exists & ~state.stage_completed
@@ -71,6 +79,8 @@ def observe(
         axis=-1,
     )
     nodes = jnp.where(node_mask[:, :, None], nodes, 0.0)
+    if params.obs_dtype == "bfloat16":
+        nodes = nodes.astype(jnp.bfloat16)
     if compute_levels:
         node_level = jnp.where(
             node_mask, state.node_level, node_mask.shape[1]

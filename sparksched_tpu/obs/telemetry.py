@@ -56,10 +56,20 @@ class Telemetry(struct.PyTreeNode):
     ev_job_arrival: jnp.ndarray  # single pops by kind
     ev_task_finished: jnp.ndarray
     ev_exec_ready: jnp.ndarray
-    bulk_relaunch_events: jnp.ndarray  # TASK_FINISHED via _bulk_relaunch
-    bulk_ready_events: jnp.ndarray  # EXECUTOR_READY via _bulk_ready
+    bulk_relaunch_events: jnp.ndarray  # TASK_FINISHED via bulk passes
+    bulk_ready_events: jnp.ndarray  # EXECUTOR_READY via bulk passes
     bulk_fulfill_hits: jnp.ndarray  # candidates via _bulk_fulfill
     commit_rounds: jnp.ndarray  # finished commitment rounds
+    # --- per-phase while-iteration split (ISSUE 7) ---
+    # bulk-phase iterations: micro-steps (flat) / resume-loop
+    # iterations (core) whose bulk pass consumed >= 1 event — the
+    # decide/fulfill/event phases' iteration counts are decide_steps /
+    # fulfill_steps / event_steps; this completes the per-phase split
+    bulk_passes: jnp.ndarray
+    # inter-decision while-loop body iterations: `drain_to_decision`
+    # (flat single-eval path) / `_resume_simulation` (core). Max/mean
+    # over lanes IS the measured batch-max drain tax.
+    drain_iters: jnp.ndarray
 
 
 def telemetry_zeros() -> Telemetry:
@@ -140,6 +150,9 @@ def summarize(tm: Telemetry, prev=None) -> dict[str, Any]:
     events_total = sum(events_by_kind.values())
     frac = lambda n: round(n / micro, 4) if micro else 0.0  # noqa: E731
     per_dec = lambda n: round(n / decide, 3) if decide else 0.0  # noqa: E731
+    di = np.asarray(t.drain_iters).ravel().astype(np.float64)
+    mean_di = float(di.mean()) if lanes else 0.0
+    drain_straggler = float(di.max() / mean_di) if mean_di > 0 else 1.0
     return {
         "lanes": lanes,
         "decisions": decide,
@@ -160,6 +173,18 @@ def summarize(tm: Telemetry, prev=None) -> dict[str, Any]:
             "fulfill_hits": tot(t.bulk_fulfill_hits),
         },
         "fulfillments": fulfill + tot(t.bulk_fulfill_hits),
+        # per-phase while-iteration split (ISSUE 7): the engine's
+        # iteration budget attributed to decide / fulfill / event /
+        # bulk phases — scripts_phase_rank.py ranks these per decision
+        "phase_iters": {
+            "decide": decide,
+            "fulfill": fulfill,
+            "event": event,
+            "bulk": tot(t.bulk_passes),
+        },
+        "drain_iters_mean": round(mean_di, 2),
+        "drain_iters_max": int(di.max()) if lanes else 0,
+        "drain_straggler_ratio": round(drain_straggler, 3),
         "loop_iters_mean": round(mean_li, 2),
         "loop_iters_max": int(li.max()) if lanes else 0,
         "straggler_ratio": round(straggler, 3),

@@ -78,8 +78,12 @@ def build_features(
     is_src = (obs.source_job >= 0) & (j_idx == obs.source_job)
     caps = jnp.where(is_src, committable, caps)
 
-    remaining = obs.nodes[..., 0]
-    duration = obs.nodes[..., 1]
+    # f32 accumulation at the use site: under the low-precision
+    # observation layout (params.obs_dtype = bf16) the feature bank
+    # arrives narrow; the normalization arithmetic below must not run
+    # in bf16, so each read upcasts first (lossless for bf16 inputs)
+    remaining = obs.nodes[..., 0].astype(jnp.float32)
+    duration = obs.nodes[..., 1].astype(jnp.float32)
     x = jnp.stack(
         [
             jnp.broadcast_to((caps / n)[:, None], remaining.shape),

@@ -65,8 +65,13 @@ class StoredObs(struct.PyTreeNode):
 
 
 def store_obs(obs: Observation, state: EnvState) -> StoredObs:
+    # `remaining` comes from the state, not `nodes[..., 0]`: the count
+    # must stay exactly i32 even when `params.obs_dtype` narrows the
+    # feature bank to bf16 (whose 8-bit mantissa rounds counts > 256);
+    # `duration` deliberately inherits the bank's (possibly narrow)
+    # dtype — it is the lane-scaled buffer the layout exists to halve
     return StoredObs(
-        remaining=obs.nodes[..., 0].astype(_i32),
+        remaining=jnp.where(obs.node_mask, state.stage_remaining, 0),
         duration=obs.nodes[..., 1],
         schedulable=obs.schedulable,
         node_mask=obs.node_mask,
@@ -93,7 +98,9 @@ def stored_to_observation(bank: WorkloadBank, so: StoredObs) -> Observation:
     nodes = jnp.stack(
         [
             so.remaining.astype(jnp.float32),
-            so.duration,
+            # f32 accumulation at the use site: a bf16-recorded
+            # duration upcasts losslessly here
+            so.duration.astype(jnp.float32),
             so.schedulable.astype(jnp.float32),
         ],
         axis=-1,
@@ -395,10 +402,15 @@ def flat_micro_group_budget(
 
 def _zero_stored(params: EnvParams) -> StoredObs:
     j, s = params.max_jobs, params.max_stages
-    f32 = jnp.float32
+    # duration mirrors the observation bank's dtype (params.obs_dtype):
+    # the scan carry's buffer and the per-step `store_obs` record must
+    # agree or the collection scan fails its carry dtype check
+    dur_dt = (
+        jnp.bfloat16 if params.obs_dtype == "bfloat16" else jnp.float32
+    )
     return StoredObs(
         remaining=jnp.zeros((j, s), _i32),
-        duration=jnp.zeros((j, s), f32),
+        duration=jnp.zeros((j, s), dur_dt),
         schedulable=jnp.zeros((j, s), bool),
         node_mask=jnp.zeros((j, s), bool),
         job_mask=jnp.zeros((j,), bool),
@@ -442,6 +454,7 @@ def _flat_collect(
     rollout_duration,
     use_elapsed: bool,
     telemetry=None,
+    bulk_fused: bool = True,
 ):
     """Shared flat-engine collection scan for one lane (vmap over lanes).
 
@@ -498,7 +511,7 @@ def _flat_collect(
             params, bank, policy_fn, ls, sub, auto_reset, True,
             event_bulk, bulk_events, fulfill_bulk, bulk_cycles,
             record=True, reset_fn=reset_fn, t_ref=t_ref,
-            telemetry=tm,
+            telemetry=tm, bulk_fused=bulk_fused,
         )
         (ls2, rec, tm) = out if track else (out + (None,))
         # advance the discount reference BEFORE the burst sub-steps: with
@@ -513,7 +526,7 @@ def _flat_collect(
                 params, bank, ls2, sub, auto_reset, event_bulk,
                 bulk_events, bulk_cycles,
                 record=True, reset_fn=reset_fn, t_ref=t_ref,
-                telemetry=tm,
+                telemetry=tm, bulk_fused=bulk_fused,
             )
             (ls2, (rw, dd, rr), tm) = (
                 out if track else (out + (None,))
@@ -605,7 +618,7 @@ def _flat_collect(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "micro_groups", "event_burst", "event_bulk", "bulk_events",
-        "fulfill_bulk", "bulk_cycles",
+        "fulfill_bulk", "bulk_cycles", "bulk_fused",
     ),
 )
 def collect_flat_sync(
@@ -623,6 +636,7 @@ def collect_flat_sync(
     bulk_events: int = 8,
     fulfill_bulk: bool = False,
     bulk_cycles: int = 1,
+    bulk_fused: bool = True,
 ) -> Rollout | tuple:
     """Flat-engine equivalent of `collect_sync`: one episode from the
     given freshly-reset state, micro-stepped with frozen lanes at episode
@@ -636,7 +650,7 @@ def collect_flat_sync(
         auto_reset=False, event_burst=event_burst, event_bulk=event_bulk,
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fn=None, rollout_duration=None,
-        use_elapsed=False, telemetry=telemetry,
+        use_elapsed=False, telemetry=telemetry, bulk_fused=bulk_fused,
     )
     return (out[0], out[2]) if telemetry is not None else out[0]
 
@@ -691,6 +705,7 @@ def _flat_collect_single_eval(
     use_elapsed: bool,
     telemetry=None,
     lane_shard=None,
+    bulk_fused: bool = True,
 ):
     """Shared single-eval collection scan over the WHOLE lane batch
     (`ls` carries a leading [B] axis; no outer vmap). Exactly
@@ -746,7 +761,7 @@ def _flat_collect_single_eval(
             return drain_to_decision(
                 params, bank, l, k_, auto_reset, event_bulk,
                 bulk_events, bulk_cycles, reset_fn=rf, t_ref=tr,
-                telemetry=t_,
+                telemetry=t_, bulk_fused=bulk_fused,
             )
 
         return jax.vmap(one)(ls, keys, li, t_ref, tm)
@@ -885,7 +900,7 @@ def _flat_collect_single_eval(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "event_bulk", "bulk_events", "fulfill_bulk", "bulk_cycles",
-        "lane_shard",
+        "lane_shard", "bulk_fused",
     ),
 )
 def collect_flat_sync_batch(
@@ -902,6 +917,7 @@ def collect_flat_sync_batch(
     fulfill_bulk: bool = True,
     bulk_cycles: int = 1,
     lane_shard=None,
+    bulk_fused: bool = True,
 ) -> Rollout | tuple:
     """Single-eval flat equivalent of `vmap(collect_sync)`: one episode
     per lane from the given freshly-reset [B] states, exactly one policy
@@ -917,6 +933,7 @@ def collect_flat_sync_batch(
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fns=None, rollout_duration=None,
         use_elapsed=False, telemetry=telemetry, lane_shard=lane_shard,
+        bulk_fused=bulk_fused,
     )
     return (out[0], out[2]) if telemetry is not None else out[0]
 
@@ -925,7 +942,7 @@ def collect_flat_sync_batch(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "event_bulk", "bulk_events", "fulfill_bulk", "bulk_cycles",
-        "lane_shard",
+        "lane_shard", "bulk_fused",
     ),
 )
 def collect_flat_async_batch(
@@ -946,6 +963,7 @@ def collect_flat_async_batch(
     fulfill_bulk: bool = True,
     bulk_cycles: int = 1,
     lane_shard=None,
+    bulk_fused: bool = True,
 ) -> tuple:
     """Single-eval flat equivalent of `vmap(collect_flat_async)`:
     persistent [B] lanes, fixed sim-time budget, group-shared mid-scan
@@ -986,6 +1004,7 @@ def collect_flat_async_batch(
         fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
         reset_fns=reset_fns, rollout_duration=rollout_duration,
         use_elapsed=True, telemetry=telemetry, lane_shard=lane_shard,
+        bulk_fused=bulk_fused,
     )
     ro, ls = out[0], out[1]
     ro = ro.replace(final_reset_count=reset_counts + ls.episodes)
@@ -998,7 +1017,7 @@ def collect_flat_async_batch(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "micro_groups", "event_burst", "event_bulk", "bulk_events",
-        "fulfill_bulk", "bulk_cycles",
+        "fulfill_bulk", "bulk_cycles", "bulk_fused",
     ),
 )
 def collect_flat_async(
@@ -1020,6 +1039,7 @@ def collect_flat_async(
     bulk_events: int = 8,
     fulfill_bulk: bool = False,
     bulk_cycles: int = 1,
+    bulk_fused: bool = True,
 ) -> tuple:
     """Flat-engine equivalent of `collect_async`: persistent lanes with a
     fixed sim-time budget per iteration and mid-scan auto-resets drawn
@@ -1056,7 +1076,7 @@ def collect_flat_async(
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fn=reset_fn,
         rollout_duration=rollout_duration, use_elapsed=True,
-        telemetry=telemetry,
+        telemetry=telemetry, bulk_fused=bulk_fused,
     )
     ro, ls = out[0], out[1]
     ro = ro.replace(final_reset_count=reset_count + ls.episodes)

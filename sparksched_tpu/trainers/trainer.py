@@ -226,7 +226,8 @@ class Trainer(abc.ABC):
         self.bank = make_workload_bank(
             self.params_env.num_executors, self.params_env.max_stages,
             **{k: v for k, v in env_cfg.items()
-               if k in ("data_dir", "bucket_size")},
+               if k in ("data_dir", "bucket_size", "data_sampler_cls",
+                        "bank_dtype")},
         )
         if self.bank.max_stages != self.params_env.max_stages:
             self.params_env = self.params_env.replace(
@@ -317,6 +318,10 @@ class Trainer(abc.ABC):
                               self.flat_single_eval)
             ),
             "bulk_cycles": int(train_cfg.get("flat_bulk_cycles", 1)),
+            # ISSUE 7: single fused bulk kernel (mixed relaunch/arrival
+            # runs in one pass) vs the round-3/4 pass pair; step-exact
+            # either way, so this is purely a dispatch-count knob
+            "bulk_fused": bool(train_cfg.get("flat_bulk_fused", True)),
         }
         # the batch (single-eval) collectors take no event_burst —
         # bursts amortized the policy eval the restructure removed

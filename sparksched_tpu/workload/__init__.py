@@ -19,8 +19,10 @@ from .bank import (  # noqa: F401
     EXEC_LEVEL_VALUES,
     NUM_EXEC_LEVELS,
     WorkloadBank,
+    bank_dtype_label,
     load_tpch_templates,
     pack_bank,
+    quantize_bank,
 )
 from .synthetic import make_templates  # noqa: F401
 
@@ -79,11 +81,15 @@ def make_workload_bank(
     data_dir: str = "data/tpch",
     seed: int = 2024,
     data_sampler_cls: str | None = None,
+    bank_dtype: str | None = None,
     **_: object,
 ) -> WorkloadBank:
     """Factory mirroring the reference `make_data_sampler`
     (spark_sched_sim/data_samplers/__init__.py:9-15): dispatches on the
-    `data_sampler_cls` config string through the provider registry."""
+    `data_sampler_cls` config string through the provider registry.
+    `bank_dtype` (ISSUE 7; an `env:` config key — "int16", "int8" or
+    "bf16", default f32) selects the low-precision duration-table
+    layout via `quantize_bank`."""
     name = data_sampler_cls or "TPCHDataSampler"
     if name not in _DATA_SAMPLERS:
         raise ValueError(
@@ -100,7 +106,10 @@ def make_workload_bank(
     max_stages = max(
         max_stages, max(t["adj"].shape[0] for t in templates)
     )
-    return pack_bank(templates, num_executors, max_stages, bucket_size)
+    bank = pack_bank(templates, num_executors, max_stages, bucket_size)
+    if bank_dtype is not None:
+        bank = quantize_bank(bank, bank_dtype)
+    return bank
 
 
 # drop-in alias for the reference factory name

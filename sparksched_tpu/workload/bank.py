@@ -71,6 +71,15 @@ class WorkloadBank(struct.PyTreeNode):
     itv_left_idx: jnp.ndarray  # i32[N+1]
     itv_right_idx: jnp.ndarray  # i32[N+1]
 
+    # --- low-precision layout (ISSUE 7) ---
+    # When `dur` carries an integer dtype (int8/int16 via
+    # `quantize_bank`), `dur_scale` is the per-template f32[T]
+    # LOG-domain dequantization scale:
+    # duration = expm1(dur.astype(f32) * dur_scale[t]),
+    # applied at the single use site (`sampling.sample_task_duration`)
+    # so every accumulation stays f32. None for f32/bf16 banks.
+    dur_scale: jnp.ndarray | None = None
+
     @property
     def num_templates(self) -> int:
         return self.num_stages.shape[0]
@@ -222,6 +231,64 @@ def pack_bank(
         itv_right_val=jnp.asarray(itv[:, 1], dtype=jnp.int32),
         itv_left_idx=jnp.asarray(to_idx(itv[:, 0]), dtype=jnp.int32),
         itv_right_idx=jnp.asarray(to_idx(itv[:, 1]), dtype=jnp.int32),
+    )
+
+
+BANK_DTYPES = ("f32", "float32", "bf16", "bfloat16", "int8", "int16")
+
+
+def bank_dtype_label(bank: WorkloadBank) -> str:
+    """Short dtype tag of a bank's `dur` table for bench-row stamps
+    ("f32", "bf16", "int8", "int16")."""
+    name = str(bank.dur.dtype)
+    return {"float32": "f32", "bfloat16": "bf16"}.get(name, name)
+
+
+def quantize_bank(bank: WorkloadBank, dtype: str = "int16"
+                  ) -> WorkloadBank:
+    """Re-encode the bank's `dur[T,S,3,L,K]` table — by far its largest
+    array — in a narrow dtype (ISSUE 7 low-precision bank layout).
+
+    int8/int16: LOG-domain quantization with a per-template f32 scale
+    (`q = rint(log1p(dur) / dur_scale[t])`, `dur_scale[t] =
+    log1p(max(dur[t])) / intmax`). TPC-H durations are heavy-tailed
+    (per-template maxima in the millions of ms against typical tasks
+    of hundreds), so a LINEAR step of max/intmax would put ~50 ms of
+    absolute error on every short task; the log code makes the error
+    RELATIVE instead — bounded by expm1(dur_scale[t]/2), i.e. ~1.2e-4
+    for int16 and ~6e-2 for int8, uniformly across the tail. The
+    observe-path drift this buys is pinned by
+    tests/test_workload_ingest.py's epsilon test.
+    bfloat16: a plain cast (8-bit mantissa, no scale needed).
+
+    Dequantization to f32 (`expm1(q * dur_scale[t])`) happens at the
+    single gather site (`sampling.sample_task_duration`), so the env
+    state, rewards and every accumulation stay f32; only the resident
+    table and its gathers narrow. `rough_duration` ([T,S], vanishingly
+    small next to the K-sample buckets) stays f32 — it is the
+    empty-bucket fallback and feeds observations directly."""
+    if dtype in ("f32", "float32"):
+        return bank
+    if dtype in ("bf16", "bfloat16"):
+        return bank.replace(
+            dur=bank.dur.astype(jnp.bfloat16), dur_scale=None
+        )
+    if dtype not in ("int8", "int16"):
+        raise ValueError(
+            f"unknown bank dtype {dtype!r} (have: {BANK_DTYPES})"
+        )
+    imax = 127 if dtype == "int8" else 32767
+    # quantize in f64 on the host: an f32 log/division can land a
+    # value epsilon-across a .5 step boundary and round one step off,
+    # which would break the half-step error bound the epsilon test pins
+    ldur = np.log1p(np.asarray(bank.dur, dtype=np.float64))
+    t_max = ldur.reshape(ldur.shape[0], -1).max(axis=1)
+    scale = np.where(t_max > 0, t_max / imax, 1.0)
+    q = np.rint(ldur / scale[:, None, None, None, None])
+    q = np.clip(q, 0, imax).astype(dtype)
+    scale = scale.astype(np.float32)
+    return bank.replace(
+        dur=jnp.asarray(q), dur_scale=jnp.asarray(scale)
     )
 
 

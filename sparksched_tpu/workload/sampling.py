@@ -118,6 +118,15 @@ def sample_task_duration(
     n = jnp.maximum(cnt[wave], 1)
     pick = jnp.minimum((u2[1] * n).astype(jnp.int32), n - 1)
     dur = bank.dur[template, stage, wave, li, pick]
+    if dur.dtype != jnp.float32:
+        # low-precision bank layout (ISSUE 7): the gather stays narrow,
+        # everything downstream accumulates in f32. Integer banks carry
+        # a per-template LOG-domain dequantization scale (relative
+        # error ~dur_scale/2 uniformly across the heavy tail — see
+        # workload.quantize_bank); bf16 banks just upcast.
+        dur = dur.astype(jnp.float32)
+        if bank.dur_scale is not None:
+            dur = jnp.expm1(dur * bank.dur_scale[template])
     dur = jnp.where(
         cnt[wave] > 0, dur, bank.rough_duration[template, stage]
     )

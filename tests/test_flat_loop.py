@@ -178,10 +178,32 @@ def test_telemetry_parity_core_vs_flat():
     # micro-step composition is defined (decide+fulfill+event == all
     # micro-steps) and the core loop measured its while iterations
     comp = sum_flat["composition"]
+    # fractions are rounded to 4 decimals in summarize(), so the sum
+    # carries up to 3 half-ulp rounding errors
     assert abs(
         comp["decide"] + comp["fulfill"] + comp["event"] - 1.0
-    ) < 1e-6
+    ) < 2e-4
     assert sum_core["loop_iters_mean"] > 0
+    # ISSUE 7 per-phase split: single pops + productive bulk passes
+    # describe the same trajectory on both engines, and the drain
+    # iteration counter measures each engine's inter-decision loop
+    assert sum_core["phase_iters"]["event"] > 0
+    assert sum_flat["phase_iters"]["bulk"] > 0
+    assert sum_core["phase_iters"]["bulk"] > 0
+    # the decide phase IS the decision count on both engines; fulfill
+    # PHASE iters are per-engine quantities (core fulfills via the bulk
+    # prefix here -> 0 single steps; flat's default is one FULFILL
+    # micro-step each) whose cross-engine invariant is the
+    # `fulfillments` total asserted above
+    assert sum_core["phase_iters"]["decide"] == (
+        sum_flat["phase_iters"]["decide"]
+    ) == sum_flat["decisions"]
+    assert sum_flat["phase_iters"]["fulfill"] > 0
+    # core's inter-decision while-loop is measured by drain_iters; the
+    # flat run here never enters `drain_to_decision` (micro-step path),
+    # so its drain counter stays zero by construction
+    assert sum_core["drain_iters_mean"] > 0
+    assert sum_flat["drain_iters_mean"] == 0
 
 
 @pytest.mark.slow
@@ -684,10 +706,150 @@ def test_single_eval_flat_collection_one_policy_eval_per_decide(
     assert calls["n"] == T, (calls["n"], T)
 
 
+# slow tier: the fast tier already pins the fused kernel two ways —
+# fused-vs-core-sequential via test_bulk_paths_...'s run_flat section
+# (bulk_fused defaults True) and direct fused-vs-unfused on the
+# recorded single-eval path below; these whole-episode plain sweeps
+# are the belt-and-braces run (tier-1 runs against a hard time budget)
+@pytest.mark.slow
+@pytest.mark.parametrize("moving_delay", [2000.0, 700.0])
+def test_fused_bulk_pass_matches_unfused_plain(monkeypatch, moving_delay):
+    """ISSUE 7 fused-kernel parity, plain (no recording): the flat
+    engine with the single fused bulk kernel (`bulk_fused=True`,
+    `core._bulk_events_fused` — mixed relaunch/arrival runs in exact
+    queue order, one pass) must reach the SAME terminal state as the
+    round-3/4 (relaunch cascade + arrival burst) pass pair at fixed
+    seeds with a deterministic duration sampler. The engines take
+    different micro-step sequences (the fused pass consumes mixed runs
+    the pair splits across kind-switch micro-steps), so `bulked`/`mode`
+    legitimately differ — everything else must agree bit-for-bit.
+    moving_delay=700 forces dense interleavings of relaunch-generated
+    finishes with arrival bursts, the regime where the two engines'
+    pass boundaries differ most."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.config import EnvParams
+    from sparksched_tpu.env import core
+    from sparksched_tpu.env.flat_loop import run_flat
+    from sparksched_tpu.schedulers import round_robin_policy
+    from sparksched_tpu.workload import make_workload_bank
+
+    def det_sampler(params, bank, rng, template, stage, num_local,
+                    task_valid, same_stage):
+        base = bank.rough_duration[template, stage] * 0.05
+        return (
+            base
+            + jnp.where(task_valid & same_stage, 7.0, 131.0)
+            + 17.0 * stage.astype(jnp.float32)
+            + 3.0 * num_local.astype(jnp.float32)
+        )
+
+    monkeypatch.setattr(core, "sample_task_duration", det_sampler)
+
+    params = EnvParams(
+        num_executors=6, max_jobs=12, max_stages=20, max_levels=20,
+        moving_delay=moving_delay, warmup_delay=1000.0,
+        job_arrival_rate=4e-5, mean_time_limit=None,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    for seed in (0, 3):
+        s0 = core.reset(params, bank, jax.random.PRNGKey(seed))
+        outs = {}
+        for fused in (True, False):
+            outs[fused] = jax.jit(
+                lambda s, r, f=fused: run_flat(
+                    params, bank, pol, r, 6000, s, auto_reset=False,
+                    fulfill_bulk=True, bulk_fused=f,
+                )
+            )(s0, jax.random.PRNGKey(0))
+        a, b = outs[True], outs[False]
+        assert int(a.episodes) == int(b.episodes) == 1, f"seed {seed}"
+        assert int(a.decisions) == int(b.decisions), f"seed {seed}"
+        la = jax.tree_util.tree_leaves_with_path(a)
+        lb = jax.tree_util.tree_leaves(b)
+        for (pa, x), y in zip(la, lb):
+            name = jax.tree_util.keystr(pa)
+            # rng streams legitimately differ (one batched draw per
+            # fused pass vs one per unfused pass); `bulked` counts
+            # passes-by-construction; `mode` is dead state on a frozen
+            # lane reached via different micro-step sequences
+            if name in (".env.rng", ".bulked", ".mode"):
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y),
+                err_msg=f"seed {seed}, field {name}",
+            )
+
+
+def test_fused_bulk_pass_matches_unfused_recorded(monkeypatch):
+    """ISSUE 7 fused-kernel parity with `record=True`: the single-eval
+    batch collector (decide micro-step + drain-to-decision — the path
+    whose drain now runs the cheap-cond/`masked=False` body) must
+    produce an IDENTICAL Rollout under `bulk_fused` on/off at fixed
+    seeds — actions, log-probs, rewards, wall times, valid mask, and
+    the stored observations the PPO update rebuilds features from."""
+    import jax
+
+    from sparksched_tpu.env import core
+    from sparksched_tpu.trainers.rollout import collect_flat_sync_batch
+
+    params, bank, make_sched = _decima_parity_fixture(monkeypatch)
+    sched = make_sched()
+    bpol = sched.flat_batch_policy(deterministic=True)
+
+    T = 120
+    keys = [jax.random.PRNGKey(3), jax.random.PRNGKey(5)]
+    states = jax.tree_util.tree_map(
+        lambda *a: jax.numpy.stack(a),
+        *[core.reset(params, bank, k) for k in keys],
+    )
+    ros = {}
+    for fused in (True, False):
+        ros[fused] = collect_flat_sync_batch(
+            params, bank, bpol, jax.random.PRNGKey(1), T, states,
+            fulfill_bulk=True, bulk_fused=fused,
+        )
+    a, b = ros[True], ros[False]
+    nv = int(np.asarray(a.valid).sum())
+    assert nv > 30, "fixture episode too short to be meaningful"
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves(b)
+    for (pa, x), y in zip(la, lb):
+        name = jax.tree_util.keystr(pa)
+        # the final carried env's rng differs by stream construction
+        if ".rng" in name:
+            continue
+        if name == ".reward":
+            # per-decision rewards sum the SAME per-event terms in a
+            # different partial-sum order (the fused pass consumes
+            # runs the pair splits across micro-steps) — f32
+            # associativity, not trajectory drift
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-3,
+                err_msg=f"field {name}",
+            )
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"field {name}"
+        )
+
+
 @pytest.mark.parametrize(
     "dur_scale,moving_delay",
     [
-        (1.0, 2000.0),
+        # the default-delay sweep moved to the slow tier in round 11
+        # (tier-1 time budget): the dense 0.02/700 interleaving regime
+        # below is the strictly harder coverage and stays fast
+        pytest.param(1.0, 2000.0, marks=pytest.mark.slow),
         # tiny durations + short moving delay force dense interleavings
         # of relaunch-generated finishes with arrival bursts (the
         # _bulk_ready generated-finish and source-join stop conditions)

@@ -43,6 +43,50 @@ def test_aval_bytes_tile_padding():
     assert round(aval_bytes(big) / 1e9, 1) == 19.4
 
 
+def test_aval_bytes_int8_minor_dim_padding():
+    """ISSUE 7: narrower dtypes change the tiled-layout padding math.
+    The sublane row count scales INVERSELY with itemsize (8 rows for
+    4-byte dtypes, 16 for 2-byte, 32 for 1-byte), so at the workload
+    bank's narrow [..., 8, 16] tail the padding exactly cancels the
+    dtype width — an int8/int16 dur table is NOT smaller than f32
+    under the tile model — while tile-aligned shapes keep the full
+    width win. The lane-count headroom of the low-precision layout
+    therefore comes from the lane-scaled bf16 observation buffers, not
+    the resident bank (PERF.md round 11)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.obs.memory import aval_bytes
+
+    # [8,16] tails: minor 16 -> 128 always; second-minor pads to the
+    # 32-byte sublane, i.e. 8 rows f32 / 16 rows i16 / 32 rows i8 —
+    # identical padded bytes across all three widths
+    for dt, rows in ((jnp.float32, 8), (jnp.int16, 16), (jnp.int8, 32)):
+        a = jax.ShapeDtypeStruct((8, 16), dt)
+        assert aval_bytes(a) == rows * 128 * jnp.dtype(dt).itemsize
+        assert aval_bytes(a) == 4096
+    # ... and the bank's actual dur tail behaves the same way: the
+    # tile-padded dur table is dtype-INVARIANT at (..., 8, 16)
+    shapes = {}
+    for dt in (jnp.float32, jnp.int16, jnp.int8):
+        big = jax.ShapeDtypeStruct((154, 20, 3, 8, 16), dt)
+        shapes[str(dt)] = aval_bytes(big)
+    assert len(set(shapes.values())) == 1, shapes
+    # tile-aligned shapes get the full dtype-width win (4x for int8)
+    f = aval_bytes(jax.ShapeDtypeStruct((256, 256), jnp.float32))
+    i = aval_bytes(jax.ShapeDtypeStruct((256, 256), jnp.int8))
+    assert f == 4 * i
+    # unpadded (linear-layout) bytes DO shrink 4x for the bank tail —
+    # the honest statement of where int8 helps (host RAM, transfer)
+    assert aval_bytes(
+        jax.ShapeDtypeStruct((154, 20, 3, 8, 16), jnp.int8),
+        tile_pad=False,
+    ) * 4 == aval_bytes(
+        jax.ShapeDtypeStruct((154, 20, 3, 8, 16), jnp.float32),
+        tile_pad=False,
+    )
+
+
 # ---------------------------------------------------------------------------
 # bank-broadcast rule: seeded violation + hoisted-form negative
 # ---------------------------------------------------------------------------
@@ -94,6 +138,116 @@ def test_bank_broadcast_clears_on_hoisted_form(bank):
 
     closed = _trace_vmapped(good, (_lane_pred_struct(),), 4)
     assert check_bank_broadcast("fixture", closed, bank, 4) == []
+
+
+def test_bank_broadcast_rule_covers_quantized_bank(bank):
+    """ISSUE 7: the bank-broadcast rule must keep working on the
+    low-precision bank layout — the hazard SHAPES are dtype-blind, so a
+    lane-batched producer of the int16 dur table fires exactly like the
+    f32 one, and the hoisted micro-step stays clean when driven by a
+    quantized bank."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from sparksched_tpu.analysis.jaxpr_audit import audit_setup
+    from sparksched_tpu.analysis.memory import check_bank_broadcast
+    from sparksched_tpu.obs.memory import _trace_vmapped
+    from sparksched_tpu.workload import quantize_bank
+
+    qbank = quantize_bank(bank, "int16")
+
+    def bad(x):
+        return lax.cond(
+            x > 0, lambda: qbank.dur,
+            lambda: jnp.zeros_like(qbank.dur),
+        ).sum()
+
+    closed = _trace_vmapped(bad, (_lane_pred_struct(),), 4)
+    vs = check_bank_broadcast("fixture", closed, qbank, 4)
+    assert vs and all(v.rule == "bank-broadcast" for v in vs)
+    assert any("dur" in v.detail for v in vs)
+
+    # the real engine on the quantized bank: hoisted, no violations
+    # (this is the "bank-broadcast rule must pass on the quantized
+    # bank" acceptance line — the per-template dur_scale gather at the
+    # sampling site must not smuggle a table into a lane branch)
+    from sparksched_tpu.env.flat_loop import init_loop_state, micro_step
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+
+    params, _, state = audit_setup()
+
+    def pol(rng, obs):
+        si, ne = round_robin_policy(obs, params.num_executors, True)
+        return si, ne, {}
+
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    ls = jax.eval_shape(init_loop_state, state)
+    closed = _trace_vmapped(
+        lambda l, r: micro_step(
+            params, qbank, pol, l, r, True, False, True, 8, True, 1
+        ),
+        (ls, key), 4,
+    )
+    assert check_bank_broadcast("micro_step[int16]", closed, qbank,
+                                4) == []
+
+
+def test_lane_fit_quantized_layout_strictly_more_lanes():
+    """ISSUE 7 acceptance: under the 17.2 GB per-chip budget the
+    low-precision layout (int16 dur bank + bf16 observation features,
+    `obs_dtype`) must fit STRICTLY more recording-collector lanes than
+    the f32 layout. The win comes from the lane-scaled rollout-obs
+    buffers (`StoredObs.duration` bf16 halves its tile-padded bytes);
+    the resident bank's tile-padded bytes are dtype-invariant at its
+    [...,8,16] tail (see test_aval_bytes_int8_minor_dim_padding)."""
+    import jax
+
+    from sparksched_tpu.analysis.jaxpr_audit import audit_setup
+    from sparksched_tpu.env import core
+    from sparksched_tpu.obs.memory import TPU_HBM_BUDGET_BYTES, lane_fit
+    from sparksched_tpu.schedulers.heuristics import round_robin_policy
+    from sparksched_tpu.trainers.rollout import collect_flat_sync
+    from sparksched_tpu.workload import quantize_bank
+
+    params32, bank32, _ = audit_setup()
+    params16 = params32.replace(obs_dtype="bfloat16")
+    bank16 = quantize_bank(bank32, "int16")
+
+    T = 192  # recorded decision rows: the [T,...] obs buffers are the
+    # lane-scaled bytes the layout halves, so T sets the per-lane
+    # slope — sized so the 17.2 GB crossing lands mid-candidate-range
+    # (~900 f32 lanes at audit shapes)
+
+    def make_fit(params, bank):
+        def pol(rng, obs):
+            si, ne = round_robin_policy(obs, params.num_executors, True)
+            return si, ne, {}
+
+        def lane(s, r):
+            return collect_flat_sync(
+                params, bank, pol, r, T, s, None, micro_groups=8,
+                fulfill_bulk=True,
+            )
+
+        key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        state = jax.eval_shape(
+            lambda k: core.reset(params, bank, k), key
+        )
+        return lane_fit(
+            lane, (state, key),
+            candidates=tuple(range(256, 2049, 32)),
+            budget_bytes=TPU_HBM_BUDGET_BYTES,
+        )
+
+    fit32 = make_fit(params32, bank32)
+    fit16 = make_fit(params16, bank16)
+    assert fit32["max_lanes_fit"] > 0
+    assert fit16["max_lanes_fit"] > fit32["max_lanes_fit"], (
+        f"quantized layout fits {fit16['max_lanes_fit']} lanes vs "
+        f"f32 {fit32['max_lanes_fit']} — expected strictly more under "
+        f"{TPU_HBM_BUDGET_BYTES / 1e9:.1f} GB"
+    )
 
 
 # ---------------------------------------------------------------------------
