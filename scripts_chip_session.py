@@ -42,6 +42,19 @@ by bench.py / bench_decima.py themselves (sparksched_tpu/obs), and
 with `analysis_clean` (the stage-10 verdict, re-derived per bench
 process) so perf rows from a dirty tree are self-identifying.
 
+Preemption safety (ISSUE 9): multi-stage invocations keep a
+stage-completion LEDGER (default `artifacts/chip_session_ledger.json`;
+override with CHIP_SESSION_LEDGER=<path>, disable with
+CHIP_SESSION_LEDGER=0). Each completed stage is recorded atomically
+(tmp+rename); a session relaunched after a killed tunnel window skips
+stages the ledger marks completed within the last
+CHIP_SESSION_LEDGER_TTL seconds (default 86400) and resumes from the
+first unfinished one — a ~45-minute window that dies in stage 4 no
+longer re-burns stages 1-3. Failed stages are recorded with their
+error but NOT marked completed, so they re-run. Single-stage
+invocations (the watcher's style) never consult the ledger: the
+watcher owns its own once-per-lifetime markers.
+
 Usage: python scripts_chip_session.py [stage ...]   (default: 1 2 3 4)
 """
 
@@ -501,6 +514,66 @@ def stage_fused_headline():
             break
 
 
+# ---------------------------------------------------------------------------
+# stage-completion ledger (ISSUE 9 preemption safety)
+# ---------------------------------------------------------------------------
+
+
+def _ledger_path(n_stages: int) -> str | None:
+    """Resolve the ledger file for this invocation; None = disabled.
+    Only multi-stage runs use it regardless of the env override (the
+    module contract: the env var RELOCATES the ledger, it must not turn
+    it on for the watcher's single-stage per-cycle calls — those would
+    silently skip their stage for a whole TTL after one success)."""
+    import os
+
+    env = os.environ.get("CHIP_SESSION_LEDGER")
+    if env in ("0", ""):
+        return None
+    if n_stages < 2:
+        return None
+    return env or "artifacts/chip_session_ledger.json"
+
+
+def _ledger_load(path: str) -> dict:
+    import json
+
+    try:
+        with open(path) as fp:
+            return json.load(fp)
+    except (OSError, ValueError):
+        return {}
+
+
+def _ledger_write(path: str, ledger: dict) -> None:
+    """Atomic (tmp+rename) so a kill mid-write never corrupts the
+    resume state — the same discipline as the trainer checkpoints."""
+    import json
+    import os
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fp:
+        json.dump(ledger, fp, indent=1)
+    os.replace(tmp, path)
+
+
+def _ledger_skip(ledger: dict, stage: str) -> bool:
+    import os
+
+    if stage == "1":
+        # the sanity probe is the per-invocation tunnel liveness check —
+        # cheap, and skipping it would let a resumed session run heavy
+        # stages against a wedged tunnel
+        return False
+    ttl = float(os.environ.get("CHIP_SESSION_LEDGER_TTL", 86400))
+    ent = ledger.get(stage)
+    return bool(
+        ent and ent.get("completed")
+        and time.time() - ent.get("t", 0) < ttl
+    )
+
+
 STAGES = {
     "1": ("sanity", stage_sanity),
     "2": ("burst sweep", stage_sweep),
@@ -520,12 +593,29 @@ STAGES = {
 
 if __name__ == "__main__":
     picks = sys.argv[1:] or ["1", "2", "3", "4"]
+    ledger_path = _ledger_path(len(picks))
+    ledger = _ledger_load(ledger_path) if ledger_path else {}
     for p in picks:
         name, fn = STAGES[p]
+        if ledger_path and _ledger_skip(ledger, p):
+            print(
+                f"[ledger] stage {p} ({name}) already completed at "
+                f"{ledger[p].get('t')}; skipping (delete {ledger_path} "
+                "or set CHIP_SESSION_LEDGER=0 to force a rerun)",
+                flush=True,
+            )
+            continue
         print(f"=== stage {p}: {name} ===", flush=True)
+        # ok flips True only after fn() returns: a BaseException the
+        # except below does not catch (Ctrl-C, SystemExit) still runs
+        # the finally, and an aborted stage must never be ledgered as
+        # completed
+        ok, err = False, None
         try:
             fn()
-        except Exception:
+            ok = True
+        except Exception as e:
+            ok, err = False, f"{type(e).__name__}: {e}"
             traceback.print_exc()
             if p == "1":
                 print("chip unavailable; aborting session", flush=True)
@@ -536,3 +626,9 @@ if __name__ == "__main__":
             # client
             if p not in ("7", "10", "12", "13"):
                 _mark_client_held()
+            if ledger_path:
+                ledger[p] = {
+                    "stage": name, "completed": ok,
+                    "t": round(time.time(), 1),
+                } | ({} if err is None else {"error": err[:500]})
+                _ledger_write(ledger_path, ledger)

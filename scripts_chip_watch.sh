@@ -31,6 +31,11 @@ rm -f /tmp/memcap_done
 rm -f /tmp/multichip_done
 # ... and for the fused-engine headline row (stage 13, ISSUE 7)
 rm -f /tmp/fused_headline_done
+# stage-completion ledger (ISSUE 9): per-LIFETIME like the markers
+# above — a restarted watcher must re-run its multi-stage sessions, not
+# inherit a previous lifetime's completions (the ledger's job is
+# resuming a KILLED window, which the in-loop relaunches below cover)
+rm -f artifacts/chip_session_ledger.json
 # one-time legacy sweep: earlier-round trainers (tracked only by name,
 # pre-PID-file) must not survive into this watcher's lifetime — they
 # would contend the single core untracked and never be stopped for
@@ -49,6 +54,37 @@ pkill -f "scripts_plateau_train.py" 2>/dev/null
 # stage 10 — never touches the tunnel, so it runs before any polling.
 timeout -k 30 1500 python scripts_chip_session.py 10 \
   | tee /tmp/analysis_last.log
+
+# ISSUE 9: per-stage retry with backoff. A transient stage failure
+# (rc != 0) gets ONE retry after a 60 s backoff; rc = 124 is the
+# watcher's own budget kill — that is the TRUNCATION_EXPECTED case and
+# is never retried (re-running a truncated stage would double-burn the
+# window). The distinct RETRIED:/RETRY_FAILED: markers let artifact
+# readers separate flakes (failed once, passed on retry) from real
+# failures (failed twice) from truncations (rc=124, see the
+# TRUNCATION_EXPECTED lines below).
+run_with_retry() {  # run_with_retry <budget_secs> <label> <cmd...>
+  local budget=$1 label=$2; shift 2
+  timeout -k 60 "$budget" "$@"
+  local rc=$?
+  if [ "$rc" -ne 0 ] && [ "$rc" -ne 124 ]; then
+    # honor a stop request BEFORE committing to another full stage
+    # budget: the stop file exists to free the tunnel promptly, and a
+    # retry can hold the grant for hours past it
+    if [ -f /tmp/stop_chip_watch ]; then
+      echo "RETRY_SKIPPED: $label rc=$rc; stop file present"
+      return $rc
+    fi
+    echo "RETRIED: $label rc=$rc at $(date +%H:%M:%S); one retry after 60s backoff"
+    sleep 60
+    timeout -k 60 "$budget" "$@"
+    rc=$?
+    if [ "$rc" -ne 0 ] && [ "$rc" -ne 124 ]; then
+      echo "RETRY_FAILED: $label rc=$rc (real failure, not a flake)"
+    fi
+  fi
+  return $rc
+}
 
 CPU_TRAINER_PID=/tmp/cpu_trainer.pid
 
@@ -136,7 +172,8 @@ print('ALIVE')
     # was routinely truncated); rc=124 additionally logs an explicit
     # TRUNCATION_EXPECTED marker so artifact readers never misread a
     # missing trailing row as a per-row failure.
-    timeout -k 60 3600 python scripts_chip_session.py 4
+    run_with_retry 3600 "stage 4 (decima benches)" \
+      python scripts_chip_session.py 4
     rc=$?
     echo "decima-bench rc=$rc at $(date +%H:%M:%S)"
     [ "$rc" -eq 124 ] && echo "TRUNCATION_EXPECTED: stage 4 hit its 3600s budget; trailing rows were cut by the watcher, not by row failures"
@@ -144,7 +181,8 @@ print('ALIVE')
     # round-6: decima_flat rows (flat-engine rollout collection — the
     # training fast path this round routed Decima through). Separate
     # stage so a truncated stage-4 window doesn't forfeit these rows.
-    timeout -k 60 2700 python scripts_chip_session.py 8
+    run_with_retry 2700 "stage 8 (decima flat benches)" \
+      python scripts_chip_session.py 8
     rc=$?
     echo "decima-flat-bench rc=$rc at $(date +%H:%M:%S)"
     [ "$rc" -eq 124 ] && echo "TRUNCATION_EXPECTED: stage 8 hit its 2700s budget; trailing rows were cut by the watcher, not by row failures"
@@ -202,8 +240,11 @@ print('ALIVE')
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable
     # sessions (state saved every session; a wedge mid-session loses at
-    # most iters_per_session iterations).
-    timeout -k 60 7200 python scripts_flagship_train.py 20 2
+    # most iters_per_session iterations). Retry-safe BECAUSE resumable:
+    # the second attempt resumes from the atomic train-state write, so
+    # a transient crash costs backoff, not the session's progress.
+    run_with_retry 7200 "flagship training" \
+      python scripts_flagship_train.py 20 2
     echo "flagship rc=$? at $(date +%H:%M:%S)"
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # fault-risk 1024-lane probe LAST in the chip episode: if it wedges

@@ -151,6 +151,20 @@ BUDGETS: dict[str, Budget] = {
     "flat_collect_batch": Budget(
         eqn_lo=9000, eqn_hi=16900, gather_hi=257, scatter_hi=25,
     ),
+    # ISSUE 9: the `health:`-on variants of the two production
+    # programs. Pinned 2026-08-03 — ppo_update_health 3209/43/3 (the
+    # grad sentinels + per-minibatch skip gate cost ~12% eqns, zero
+    # extra gathers/scatters), flat_collect_batch_health 12734/190/20
+    # (per-decision-row state sentinels ride the telemetry carry:
+    # +1.8% eqns, +2 scatters from the conservation goldens). The
+    # default-off programs above are byte-for-byte the PR-7 pins —
+    # which is the acceptance bar: health off must change nothing.
+    "ppo_update_health": Budget(
+        eqn_lo=1000, eqn_hi=4350, gather_hi=60, scatter_hi=5,
+    ),
+    "flat_collect_batch_health": Budget(
+        eqn_lo=9000, eqn_hi=17200, gather_hi=257, scatter_hi=27,
+    ),
 }
 
 
@@ -359,6 +373,7 @@ AUDIT_COLLECT_STEPS = 3
 
 def flat_collect_batch_callable(
     batch: int = AUDIT_COLLECT_BATCH,
+    health: bool = False,
 ) -> tuple[Callable, tuple]:
     """The single-eval flat sync collector over a native [batch] lane
     axis with the shipped Decima batch policy — the program
@@ -366,9 +381,13 @@ def flat_collect_batch_callable(
     (trainers/rollout.py:collect_flat_sync_batch; the async variant
     shares the same scan body). As (callable, abstract args); `batch`
     parameterizes the lane width so the memory pass can fit its
-    per-lane byte model from two widths."""
+    per-lane byte model from two widths. With `health`, the in-JIT
+    sentinels ride a telemetry carry — the `health:`-on production
+    configuration, audited as `flat_collect_batch_health` so the
+    sentinel cost stays inside its own eqn/byte budget."""
     import jax
 
+    from ..obs.telemetry import telemetry_zeros_like
     from ..schedulers.decima import DecimaScheduler
     from ..trainers.rollout import collect_flat_sync_batch
 
@@ -381,14 +400,18 @@ def flat_collect_batch_callable(
     )
     key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
     states_b = _batched(state, batch)
+    telem = (
+        jax.eval_shape(lambda: telemetry_zeros_like((batch,)))
+        if health else None
+    )
 
     def fn(s, r):
         return collect_flat_sync_batch(
             params, bank,
             lambda rr, oo: sched.batch_policy(rr, oo),
-            r, AUDIT_COLLECT_STEPS, s,
+            r, AUDIT_COLLECT_STEPS, s, telem,
             event_bulk=True, bulk_events=8, fulfill_bulk=True,
-            bulk_cycles=1,
+            bulk_cycles=1, health=health,
         )
 
     return fn, (states_b, key)
@@ -496,6 +519,16 @@ def program_callables(names: tuple[str, ...] | None = None
         out["ppo_update"] = ppo_update_callable()
     if want is None or "flat_collect_batch" in want:
         out["flat_collect_batch"] = flat_collect_batch_callable()
+    # the `health:`-on variants (ISSUE 9): the sentinel-instrumented
+    # production programs, budgeted separately so (a) the opt-in cost
+    # is visible and capped, and (b) the default programs above prove
+    # the off path is structurally unchanged
+    if want is None or "ppo_update_health" in want:
+        out["ppo_update_health"] = ppo_update_callable(health=True)
+    if want is None or "flat_collect_batch_health" in want:
+        out["flat_collect_batch_health"] = flat_collect_batch_callable(
+            health=True
+        )
     return out
 
 
@@ -527,12 +560,14 @@ def _trace_ppo_update():
     return jax.make_jaxpr(fn)(*args)
 
 
-def ppo_update_callable() -> tuple[Callable, tuple]:
+def ppo_update_callable(health: bool = False) -> tuple[Callable, tuple]:
     """One PPO update at a tiny audit scale (2 lanes, 16 decision
     steps), as (callable, abstract args). The rollout is abstract
     (`eval_shape` over `_collect`), so nothing episode-sized executes;
     tracing/lowering the callable then hits the real epochs x
-    minibatches scan with the remat'd GNN recompute."""
+    minibatches scan with the remat'd GNN recompute. With `health`,
+    the update carries the in-JIT grad sentinels + minibatch skip gate
+    (audited as `ppo_update_health`)."""
     import jax
     import jax.numpy as jnp
 
@@ -562,7 +597,10 @@ def ppo_update_callable() -> tuple[Callable, tuple]:
         "rollout_steps": 16,
         "checkpointing_freq": 10**9,
     }
-    trainer = PPO(agent_cfg, env_cfg, train_cfg)
+    trainer = PPO(
+        agent_cfg, env_cfg, train_cfg,
+        health_cfg={"enabled": True} if health else None,
+    )
     state = jax.eval_shape(trainer.init_state)
     it = jax.ShapeDtypeStruct((), jnp.int32)
     key = jax.eval_shape(lambda: jax.random.PRNGKey(0))

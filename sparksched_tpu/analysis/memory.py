@@ -131,6 +131,14 @@ MEM_BUDGETS: dict[str, MemBudget] = {
     # lane-batched buffer, which is what the lane-fit advisor's `mesh`
     # mode models — these bytes bound the unsharded audit program)
     "flat_collect_batch": MemBudget(temp_hi=445 * MB),
+    # ISSUE 9 `health:`-on variants (pinned 2026-08-03): the sentinels
+    # are scalar reductions, so bytes barely move — ppo_update_health
+    # 269.8 MB (vs 269.6 off), flat_collect_batch_health 330.6 MB (vs
+    # 329.8). The byte budget pins that the sentinels stay reductions:
+    # a health check that starts materializing per-lane tables would
+    # breach this long before it OOMs a chip.
+    "ppo_update_health": MemBudget(temp_hi=365 * MB),
+    "flat_collect_batch_health": MemBudget(temp_hi=450 * MB),
 }
 
 # lane counts the advisor sweeps (the bench's production range; 1024

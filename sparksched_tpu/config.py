@@ -116,6 +116,36 @@ def env_params_from_cfg(env_cfg: dict[str, Any]) -> EnvParams:
     return EnvParams(**kw)
 
 
+# ---------------------------------------------------------------------------
+# runtime robustness blocks (ISSUE 9): the known key sets of the
+# top-level `health:` and `chaos:` YAML sections. Declarative data here
+# — the single source of truth for the YAML surface — consumed by the
+# trainer (health recovery policy) and sparksched_tpu/chaos.py (fault
+# injection), both of which fail loudly on an unknown key: a typo'd
+# sentinel knob silently disabling recovery is exactly the class of
+# quiet failure the health subsystem exists to remove.
+# ---------------------------------------------------------------------------
+
+HEALTH_KEYS = frozenset({
+    "enabled",  # default True when the block is present
+    "max_retries",  # rollback+retry budget per iteration (default 2)
+    "backoff_seconds",  # exponential-backoff base (default 1.0)
+    "checkpoint_every",  # atomic train-state write cadence (0 = end only)
+    "keep",  # checkpoint generations kept for corrupt-file fallback
+    "straggler_ratio_max",  # quarantine threshold (no retry)
+})
+
+CHAOS_KEYS = frozenset({
+    "seed",  # injection-index derivation seed
+    "nan_grad",  # iterations: poison one recorded reward with NaN
+    "bank_row",  # iterations: poison one recorded obs duration row
+    "straggler",  # iterations: inflate one lane's loop_iters counter
+    "oom",  # iterations: raise a simulated RESOURCE_EXHAUSTED
+    "sigkill",  # iterations: SIGKILL the process mid-iteration
+    "straggler_factor",  # loop_iters inflation factor (default 100)
+})
+
+
 def honor_jax_platforms_env() -> None:
     """Re-assert the user's ``JAX_PLATFORMS`` choice via jax.config.
 

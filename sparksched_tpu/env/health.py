@@ -1,0 +1,185 @@
+"""In-JIT health sentinels (ISSUE 9 tentpole).
+
+A health mask is a compact i32 bitmask of invariant violations,
+computed as pure reductions inside jit — no host callbacks — so the
+training program can both *report* a fault (the mask rides the
+`obs.Telemetry` carry as `health_mask`) and *act* on it on-device (the
+PPO update skips a minibatch whose gradients tripped a sentinel,
+trainers/ppo.py). The checks are opt-in behind the top-level `health:`
+config block: with it off, no sentinel op exists in any traced program
+(the jaxpr/byte budgets pin this — see analysis/jaxpr_audit.py).
+
+Two families:
+
+- `state_health(state, prev, resetting)` — environment invariants on an
+  `EnvState` after a step/micro-step: finite wall clock and stage
+  durations, the incremental commitment/moving counters agree with
+  their slot-table golden reductions (the conservation law a corrupted
+  bank row or a bad scatter breaks first), executor residence flags
+  consistent (never common *and* moving; executing implies a valid
+  task and a finite finish time), and task-count sanity (completed
+  never exceeds the stage's task count, never decreases across a step
+  unless the lane auto-reset).
+- `grad_health(loss, grads, params)` — update invariants: finite loss,
+  finite gradients, finite parameters. Each argument is optional so
+  the PPO minibatch body can check loss+grads per step and params once
+  after the scan.
+
+Host-detected conditions (a straggler ratio above the configured
+threshold, a caught RESOURCE_EXHAUSTED) reuse bits from the same table
+so one runlog `health` record schema covers everything; those bits are
+never set inside jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .state import EnvState
+
+_i32 = jnp.int32
+
+# --- bit table (single source of truth; runlog `health` records carry
+# both the raw mask and the decoded names from this table) ---------------
+H_NONFINITE_TIME = 1  # wall_time / an existing stage's duration non-finite
+H_COMMIT_CONSERVE = 2  # incremental commit/moving counts != slot golden
+H_EXEC_CONSERVE = 4  # executor residence flags inconsistent
+H_TASK_MONOTONIC = 8  # completed-task counters decreased / exceeded caps
+H_NONFINITE_REWARD = 16  # a recorded reward was non-finite (collectors)
+H_NONFINITE_LOSS = 32  # PPO minibatch loss non-finite
+H_NONFINITE_GRAD = 64  # PPO minibatch gradients non-finite
+H_NONFINITE_PARAM = 128  # post-update parameters non-finite
+# host-detected (never set in-JIT):
+H_STRAGGLER = 256  # per-lane loop_iters max/mean above health threshold
+H_OOM = 512  # RESOURCE_EXHAUSTED caught around collect/update
+
+HEALTH_BITS: dict[str, int] = {
+    "nonfinite_time": H_NONFINITE_TIME,
+    "commit_conservation": H_COMMIT_CONSERVE,
+    "exec_conservation": H_EXEC_CONSERVE,
+    "task_monotonicity": H_TASK_MONOTONIC,
+    "nonfinite_reward": H_NONFINITE_REWARD,
+    "nonfinite_loss": H_NONFINITE_LOSS,
+    "nonfinite_grad": H_NONFINITE_GRAD,
+    "nonfinite_param": H_NONFINITE_PARAM,
+    "straggler": H_STRAGGLER,
+    "oom": H_OOM,
+}
+
+# bits worth a rollback+retry (trainers/trainer.py recovery policy); a
+# straggler is a performance observation, not state corruption — it is
+# recorded and quarantined but never triggers a rollback
+RETRYABLE_MASK = (
+    H_NONFINITE_TIME | H_COMMIT_CONSERVE | H_EXEC_CONSERVE
+    | H_TASK_MONOTONIC | H_NONFINITE_REWARD | H_NONFINITE_LOSS
+    | H_NONFINITE_GRAD | H_NONFINITE_PARAM | H_OOM
+)
+
+
+def describe_mask(mask: int) -> list[str]:
+    """Decoded bit names of a host-side mask int (runlog records carry
+    these next to the raw mask so greps don't need the bit table).
+    Host boundary by contract — callers pass concrete ints/scalars."""
+    m = int(mask)  # analysis: allow(host-scalar)
+    return [name for name, bit in HEALTH_BITS.items() if m & bit]
+
+
+def _bit(pred: jnp.ndarray, bit: int) -> jnp.ndarray:
+    return jnp.where(pred, _i32(bit), _i32(0))
+
+
+def tree_nonfinite(tree) -> jnp.ndarray:
+    """bool []: any leaf of a float pytree contains a non-finite value.
+    Integer/bool leaves are skipped (isfinite is undefined there and
+    they cannot go non-finite)."""
+    flags = [
+        ~jnp.isfinite(leaf).all()
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+    ]
+    if not flags:
+        return jnp.bool_(False)
+    out = flags[0]
+    for f in flags[1:]:
+        out = out | f
+    return out
+
+
+def state_health(
+    state: EnvState,
+    prev: EnvState | None = None,
+    resetting: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """i32 [] violation bitmask over one (unbatched) `EnvState` — vmap
+    for lane batches. `prev` enables the cross-step monotonicity check;
+    `resetting` (bool []) disables it for lanes that auto-reset inside
+    the step (a fresh episode's counters legitimately restart at 0)."""
+    # finite wall clock + finite durations on existing stages (padding
+    # slots are 0; job_arrival_time/exec_finish_time use inf as the
+    # no-event sentinel, so they are deliberately NOT checked)
+    bad_time = ~jnp.isfinite(state.wall_time) | (
+        state.stage_exists & ~jnp.isfinite(state.stage_duration)
+    ).any() | jnp.isnan(state.job_t_completed).any()
+
+    # conservation: the incrementally-maintained executor-flow counters
+    # must equal their slot/executor-table golden reductions — the
+    # first invariant a corrupted row or a misrouted scatter breaks
+    bad_commit = (
+        (state.commit_count != state.commit_count_to_stage).any()
+        | (state.moving_count != state.moving_count_to_stage).any()
+    )
+
+    # executor residence: common and moving are exclusive; a moving
+    # executor has a finite arrival, an executing one a valid task and
+    # a finite finish time
+    bad_exec = (
+        (state.exec_at_common & state.exec_moving).any()
+        | (state.exec_moving & ~jnp.isfinite(state.exec_arrive_time)).any()
+        | (state.exec_executing & ~state.exec_task_valid).any()
+        | (state.exec_executing & ~jnp.isfinite(state.exec_finish_time)).any()
+    )
+
+    # task-count sanity: completed <= total, remaining/executing >= 0
+    bad_tasks = (
+        (state.stage_completed_tasks > state.stage_num_tasks).any()
+        | (state.stage_remaining < 0).any()
+        | (state.stage_executing < 0).any()
+    )
+    if prev is not None:
+        decreased = (
+            state.stage_completed_tasks < prev.stage_completed_tasks
+        ).any() | (state.num_jobs < prev.num_jobs)
+        if resetting is not None:
+            decreased = decreased & ~resetting
+        bad_tasks = bad_tasks | decreased
+
+    return (
+        _bit(bad_time, H_NONFINITE_TIME)
+        | _bit(bad_commit, H_COMMIT_CONSERVE)
+        | _bit(bad_exec, H_EXEC_CONSERVE)
+        | _bit(bad_tasks, H_TASK_MONOTONIC)
+    )
+
+
+def reward_health(reward: jnp.ndarray) -> jnp.ndarray:
+    """i32 bitmask (same shape as `reward`): the non-finite-reward bit
+    wherever a recorded reward is not finite."""
+    return _bit(~jnp.isfinite(reward), H_NONFINITE_REWARD)
+
+
+def grad_health(
+    loss: jnp.ndarray | None = None,
+    grads=None,
+    params=None,
+) -> jnp.ndarray:
+    """i32 [] bitmask over the update-side quantities; every argument
+    optional (None contributes nothing)."""
+    mask = _i32(0)
+    if loss is not None:
+        mask = mask | _bit(~jnp.isfinite(loss), H_NONFINITE_LOSS)
+    if grads is not None:
+        mask = mask | _bit(tree_nonfinite(grads), H_NONFINITE_GRAD)
+    if params is not None:
+        mask = mask | _bit(tree_nonfinite(params), H_NONFINITE_PARAM)
+    return mask

@@ -14,6 +14,14 @@ kind) and `t` (unix seconds); the kinds the trainer/bench write:
 - `memory`: a device-memory sample (`obs.memory.device_memory_stats`
   fields — `bytes_in_use` / `peak_bytes_in_use` — plus the optional
   `iteration`/`phase` the sample brackets)
+- `health`: a tripped in-JIT health sentinel (ISSUE 9) — the raw i32
+  violation bitmask (`mask`), its decoded `bits` (env/health.py bit
+  table), the `iteration`/`attempt` it quarantines, and the recovery
+  `action` taken (rollback_retry | quarantine | gave_up)
+- `recovery`: a recovery-policy outcome — rollback+retry with its
+  backoff, a checkpoint fallback past a corrupt generation, or a
+  gave-up marker; `chaos` records mark deliberate fault injections
+  (sparksched_tpu/chaos.py) so drills are self-describing
 - `jit_compile` / `jit_compile_detail`: JIT (re)compilation events via
   `jax.monitoring` duration hooks plus the dispatch logger (the latter
   names WHICH function was traced/compiled)
@@ -151,6 +159,21 @@ class RunLog:
         if iteration is not None:
             fields["iteration"] = int(iteration)
         self.write("telemetry", summary=summary, **fields)
+
+    def health(self, mask: int, iteration: int | None = None,
+               **fields: Any) -> None:
+        """A tripped health sentinel (ISSUE 9): the raw violation
+        bitmask plus its decoded bit names (env/health.py bit table),
+        so `grep '"ev": "health"'` reads without the table. The
+        trainer adds `attempt` and the recovery `action` taken;
+        recovery outcomes themselves land as `recovery` records."""
+        from ..env.health import describe_mask  # host-side, no cycle
+
+        if iteration is not None:
+            fields["iteration"] = int(iteration)
+        self.write(
+            "health", mask=int(mask), bits=describe_mask(mask), **fields
+        )
 
     def memory(self, stats: dict[str, Any],
                iteration: int | None = None, phase: str | None = None,

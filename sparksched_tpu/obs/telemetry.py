@@ -70,6 +70,13 @@ class Telemetry(struct.PyTreeNode):
     # (flat single-eval path) / `_resume_simulation` (core). Max/mean
     # over lanes IS the measured batch-max drain tax.
     drain_iters: jnp.ndarray
+    # --- health sentinels (ISSUE 9) ---
+    # i32 violation BITMASK (env/health.py bit table), OR-accumulated
+    # via `orr` — not a counter. Stays 0 unless a collector runs with
+    # `health=True` (the opt-in `health:` config block); the subtract/
+    # summarize window math still works because bits only ever get set
+    # (so a - prev == the window's newly-set bits).
+    health_mask: jnp.ndarray
 
 
 def telemetry_zeros() -> Telemetry:
@@ -96,6 +103,17 @@ def add(tm: Telemetry | None, **deltas: Any) -> Telemetry | None:
         return None
     return tm.replace(
         **{k: getattr(tm, k) + _count(v) for k, v in deltas.items()}
+    )
+
+
+def orr(tm: Telemetry | None, **masks: Any) -> Telemetry | None:
+    """Bitwise-OR accumulation for the mask-valued fields
+    (`health_mask`): `tm.replace(field=field | mask, ...)`; passes None
+    through like `add`."""
+    if tm is None:
+        return None
+    return tm.replace(
+        **{k: getattr(tm, k) | _count(v) for k, v in masks.items()}
     )
 
 
@@ -153,6 +171,12 @@ def summarize(tm: Telemetry, prev=None) -> dict[str, Any]:
     di = np.asarray(t.drain_iters).ravel().astype(np.float64)
     mean_di = float(di.mean()) if lanes else 0.0
     drain_straggler = float(di.max() / mean_di) if mean_di > 0 else 1.0
+    hm = np.asarray(t.health_mask).ravel()
+    health_mask = (
+        int(np.bitwise_or.reduce(hm)) if hm.size else 0
+    )
+    from ..env.health import describe_mask  # host-side, no cycle
+
     return {
         "lanes": lanes,
         "decisions": decide,
@@ -185,6 +209,12 @@ def summarize(tm: Telemetry, prev=None) -> dict[str, Any]:
         "drain_iters_mean": round(mean_di, 2),
         "drain_iters_max": int(di.max()) if lanes else 0,
         "drain_straggler_ratio": round(drain_straggler, 3),
+        # health sentinels (ISSUE 9): the pooled violation bitmask, its
+        # decoded bit names, and how many lanes tripped anything —
+        # all zero/empty unless a collector ran with health=True
+        "health_mask": health_mask,
+        "health_bits": describe_mask(health_mask),
+        "unhealthy_lanes": int((hm != 0).sum()) if hm.size else 0,
         "loop_iters_mean": round(mean_li, 2),
         "loop_iters_max": int(li.max()) if lanes else 0,
         "straggler_ratio": round(straggler, 3),

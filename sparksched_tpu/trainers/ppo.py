@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..env.health import grad_health
 from ..obs.tracing import annotate
 from ..schedulers.decima import DecimaAction
 from .rollout import Rollout, stored_to_observation
@@ -46,9 +47,12 @@ def _masked_mean(x, w, n):
 class PPO(Trainer):
     def __init__(self, agent_cfg: CfgType, env_cfg: CfgType,
                  train_cfg: CfgType, mesh=None,
-                 obs_cfg: CfgType | None = None) -> None:
+                 obs_cfg: CfgType | None = None,
+                 health_cfg: CfgType | None = None,
+                 chaos_cfg: CfgType | None = None) -> None:
         super().__init__(agent_cfg, env_cfg, train_cfg, mesh=mesh,
-                         obs_cfg=obs_cfg)
+                         obs_cfg=obs_cfg, health_cfg=health_cfg,
+                         chaos_cfg=chaos_cfg)
         self.entropy_coeff = train_cfg.get("entropy_coeff", 0.0)
         self.clip_range = train_cfg.get("clip_range", 0.2)
         self.target_kl = train_cfg.get("target_kl", 0.01)
@@ -167,23 +171,36 @@ class PPO(Trainer):
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
+        # in-JIT health sentinel (ISSUE 9, opt-in via the `health:`
+        # block): a minibatch whose loss or gradients go non-finite is
+        # SKIPPED on-device — exactly the KL-stop select pattern, so a
+        # single NaN gradient can never reach the optimizer — and the
+        # violation bits accumulate into a `health_mask` stat the
+        # trainer's recovery loop reads. With health off the traced
+        # program is bit-identical to the pre-health update (the
+        # ppo_update budget pin).
+        health = bool(getattr(self, "health_enabled", False))
+
         def body(carry, x):
             params, opt_state, stop, sums = carry
             idx, ok = x
-            (_, aux), grads = grad_fn(params, idx, ok)
+            (loss_val, aux), grads = grad_fn(params, idx, ok)
             kl_bad = (
                 (aux["kl"] > 1.5 * self.target_kl)
                 if self.target_kl is not None
                 else jnp.bool_(False)
             )
             do_update = ~stop & ~kl_bad
+            if health:
+                mb_mask = grad_health(loss=loss_val, grads=grads)
+                do_update = do_update & (mb_mask == 0)
             updates, new_opt = self.tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             sel = lambda a, b: jnp.where(do_update, a, b)  # noqa: E731
             params = jax.tree_util.tree_map(sel, new_params, params)
             opt_state = jax.tree_util.tree_map(sel, new_opt, opt_state)
             computed = (~stop).astype(jnp.float32)
-            sums = {
+            new_sums = {
                 "policy_loss": sums["policy_loss"]
                 + computed * aux["policy_loss"],
                 "entropy_loss": sums["entropy_loss"]
@@ -191,11 +208,15 @@ class PPO(Trainer):
                 "kl": sums["kl"] + computed * aux["kl"],
                 "count": sums["count"] + computed,
             }
-            return (params, opt_state, stop | kl_bad, sums), None
+            if health:
+                new_sums["health"] = sums["health"] | mb_mask
+            return (params, opt_state, stop | kl_bad, new_sums), None
 
         zero = jnp.float32(0.0)
         sums0 = {"policy_loss": zero, "entropy_loss": zero, "kl": zero,
                  "count": zero}
+        if health:
+            sums0["health"] = jnp.int32(0)
         with annotate("train/ppo_update"):
             (params, opt_state, _, sums), _ = jax.lax.scan(
                 body,
@@ -209,6 +230,13 @@ class PPO(Trainer):
             "approx_kl_div": jnp.abs(sums["kl"] / n),
             "avg_num_jobs_est": avg_num_jobs,
         }
+        if health:
+            # post-update params check: the skip gate should make this
+            # unreachable, but a pre-existing non-finite parameter (a
+            # corrupt resume that slipped the digest) must still trip
+            stats["health_mask"] = sums["health"] | grad_health(
+                params=params
+            )
         return state.replace(
             params=params, opt_state=opt_state, buf=buf
         ), stats

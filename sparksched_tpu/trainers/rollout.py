@@ -38,8 +38,10 @@ from ..env.flat_loop import (
     init_loop_state,
     micro_step,
 )
+from ..env.health import reward_health, state_health
 from ..env.observe import Observation, observe
 from ..env.state import EnvState
+from ..obs.telemetry import orr as _tm_orr
 from ..obs.tracing import annotate
 from ..workload.bank import WorkloadBank
 
@@ -159,7 +161,9 @@ def _aux_fields(aux: dict, stage_idx: jnp.ndarray, num_exec: jnp.ndarray,
     return aux_action_fields(aux, stage_idx, num_exec, max_stages)
 
 
-@partial(jax.jit, static_argnums=(0, 2, 4))
+@partial(
+    jax.jit, static_argnums=(0, 2, 4), static_argnames=("health",)
+)
 def collect_sync(
     params: EnvParams,
     bank: WorkloadBank,
@@ -168,13 +172,18 @@ def collect_sync(
     num_steps: int,
     state: EnvState,
     telemetry=None,
+    health: bool = False,
 ) -> Rollout | tuple:
     """One episode (from the given freshly-reset state), padded to
     `num_steps` decisions (reference RolloutWorkerSync.collect_rollout).
     With `telemetry` (an `obs.Telemetry`), engine counters ride the scan
     carry — rolled back on frozen (done) lanes — and the call returns
-    `(Rollout, Telemetry)`."""
+    `(Rollout, Telemetry)`. With `health` (static; requires telemetry),
+    each live step additionally ORs the `env/health.py` sentinel mask
+    over the post-step state + reward into `telemetry.health_mask`."""
     track = telemetry is not None
+    if health and not track:
+        raise ValueError("health=True requires a telemetry carry")
 
     def body(carry, _):
         if track:
@@ -196,6 +205,9 @@ def collect_sync(
             nxt, reward, _, _ = core.step(
                 params, bank, st, stage_idx, num_exec
             )
+        if health:
+            hm = state_health(nxt, prev=st) | reward_health(reward)
+            tm = _tm_orr(tm, health_mask=jnp.where(done, 0, hm))
         nxt = jax.tree_util.tree_map(
             lambda a, b: jnp.where(done, a, b), st, nxt
         )
@@ -236,7 +248,9 @@ def collect_sync(
     return (ro, carry[2]) if track else ro
 
 
-@partial(jax.jit, static_argnums=(0, 2, 4))
+@partial(
+    jax.jit, static_argnums=(0, 2, 4), static_argnames=("health",)
+)
 def collect_async(
     params: EnvParams,
     bank: WorkloadBank,
@@ -249,6 +263,7 @@ def collect_async(
     lane_salt: jnp.ndarray | int = 0,
     reset_count: jnp.ndarray | int = 0,
     telemetry=None,
+    health: bool = False,
 ) -> Rollout | tuple:
     """Fixed sim-time budget with persistent envs and auto-reset (reference
     RolloutWorkerAsync.collect_rollout:171-206). `wall_times` are *elapsed*
@@ -267,6 +282,8 @@ def collect_async(
     (core.reset_pair's seq/lane split). When `seq_base` is None (ad-hoc
     use outside a trainer), `rng` stands in for it."""
     track = telemetry is not None
+    if health and not track:
+        raise ValueError("health=True requires a telemetry carry")
     rollout_duration = jnp.float32(rollout_duration)
     if seq_base is None:
         seq_base = rng
@@ -293,6 +310,11 @@ def collect_async(
             nxt, reward, term, trunc = core.step(
                 params, bank, st, stage_idx, num_exec
             )
+        if health:
+            # on the post-step, PRE-reset state (the reset select below
+            # swaps in a fresh episode for done lanes)
+            hm = state_health(nxt, prev=st) | reward_health(reward)
+            tm = _tm_orr(tm, health_mask=jnp.where(over, 0, hm))
         new_elapsed = elapsed + (nxt.wall_time - st.wall_time)
         done = term | trunc
 
@@ -455,6 +477,7 @@ def _flat_collect(
     use_elapsed: bool,
     telemetry=None,
     bulk_fused: bool = True,
+    health: bool = False,
 ):
     """Shared flat-engine collection scan for one lane (vmap over lanes).
 
@@ -475,8 +498,14 @@ def _flat_collect(
 
     With `telemetry`, engine counters ride the scan carry (rolled back
     on frozen lanes) and the returned tuple gains a trailing
-    Telemetry."""
+    Telemetry. With `health` (static; requires telemetry), each live
+    micro-step group ORs the `env/health.py` sentinel mask over the
+    group's post-state + accumulated reward into
+    `telemetry.health_mask` (monotonicity checks are suppressed across
+    in-group auto-resets)."""
     track = telemetry is not None
+    if health and not track:
+        raise ValueError("health=True requires a telemetry carry")
     T = num_steps
     zs = _zero_stored(params)
     buf0 = _FlatBuf(
@@ -534,6 +563,10 @@ def _flat_collect(
             reward = reward + rw
             dt = dt + dd
             reset = reset | rr
+        if health:
+            hm = state_health(
+                ls2.env, prev=env0, resetting=reset
+            ) | reward_health(reward)
 
         # frozen lanes: state untouched, nothing recorded
         ls2 = jax.tree_util.tree_map(
@@ -543,6 +576,8 @@ def _flat_collect(
             tm = jax.tree_util.tree_map(
                 lambda a, b: jnp.where(over, a, b), tm_frozen, tm
             )
+        if health:
+            tm = _tm_orr(tm, health_mask=jnp.where(over, 0, hm))
         zero = jnp.float32(0.0)
         reward = jnp.where(over, zero, reward)
         dt = jnp.where(over, zero, dt)
@@ -618,7 +653,7 @@ def _flat_collect(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "micro_groups", "event_burst", "event_bulk", "bulk_events",
-        "fulfill_bulk", "bulk_cycles", "bulk_fused",
+        "fulfill_bulk", "bulk_cycles", "bulk_fused", "health",
     ),
 )
 def collect_flat_sync(
@@ -637,13 +672,16 @@ def collect_flat_sync(
     fulfill_bulk: bool = False,
     bulk_cycles: int = 1,
     bulk_fused: bool = True,
+    health: bool = False,
 ) -> Rollout | tuple:
     """Flat-engine equivalent of `collect_sync`: one episode from the
     given freshly-reset state, micro-stepped with frozen lanes at episode
     end, padded to `num_steps` decisions. `micro_groups` bounds the scan
     (size it at ~3-4 micro-step groups per expected decision; a too-small
     value truncates the episode exactly like a too-small `num_steps`).
-    With `telemetry`, returns `(Rollout, Telemetry)`."""
+    With `telemetry`, returns `(Rollout, Telemetry)`; `health` (static)
+    additionally ORs the in-JIT sentinel mask into
+    `telemetry.health_mask` per live group."""
     out = _flat_collect(
         params, bank, policy_fn, rng, num_steps,
         init_loop_state(state), micro_groups,
@@ -651,6 +689,7 @@ def collect_flat_sync(
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fn=None, rollout_duration=None,
         use_elapsed=False, telemetry=telemetry, bulk_fused=bulk_fused,
+        health=health,
     )
     return (out[0], out[2]) if telemetry is not None else out[0]
 
@@ -706,6 +745,7 @@ def _flat_collect_single_eval(
     telemetry=None,
     lane_shard=None,
     bulk_fused: bool = True,
+    health: bool = False,
 ):
     """Shared single-eval collection scan over the WHOLE lane batch
     (`ls` carries a leading [B] axis; no outer vmap). Exactly
@@ -718,8 +758,15 @@ def _flat_collect_single_eval(
     via `with_sharding_constraint`, so the whole collection runs SPMD
     with the lane axis sharded end-to-end instead of leaving the carry
     layout to the partitioner's fallback (which can silently replicate
-    the largest resident buffers of the program)."""
+    the largest resident buffers of the program).
+
+    With `health` (static; requires telemetry), each decision row ORs
+    the per-lane `env/health.py` sentinel mask over the post-drain
+    state + the row's accumulated reward into
+    `telemetry.health_mask`."""
     track = telemetry is not None
+    if health and not track:
+        raise ValueError("health=True requires a telemetry carry")
     T = num_steps
     B = ls.mode.shape[0]
     s_cap = params.max_stages
@@ -815,6 +862,10 @@ def _flat_collect_single_eval(
         reward = rw1 + rw2
         dt = dt1 + dt2
         reset = rs1 | rs2
+        if health:
+            hm = jax.vmap(state_health)(
+                ls3.env, env0, reset
+            ) | reward_health(reward)
 
         # frozen lanes (async budget exhausted): state untouched,
         # nothing recorded
@@ -828,6 +879,8 @@ def _flat_collect_single_eval(
             tm = jax.tree_util.tree_map(
                 lambda a, b: jnp.where(over, a, b), tm_frozen, tm
             )
+        if health:
+            tm = _tm_orr(tm, health_mask=jnp.where(over, 0, hm))
         zero = jnp.float32(0.0)
         reward = jnp.where(over, zero, reward)
         dt = jnp.where(over, zero, dt)
@@ -900,7 +953,7 @@ def _flat_collect_single_eval(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "event_bulk", "bulk_events", "fulfill_bulk", "bulk_cycles",
-        "lane_shard", "bulk_fused",
+        "lane_shard", "bulk_fused", "health",
     ),
 )
 def collect_flat_sync_batch(
@@ -918,6 +971,7 @@ def collect_flat_sync_batch(
     bulk_cycles: int = 1,
     lane_shard=None,
     bulk_fused: bool = True,
+    health: bool = False,
 ) -> Rollout | tuple:
     """Single-eval flat equivalent of `vmap(collect_sync)`: one episode
     per lane from the given freshly-reset [B] states, exactly one policy
@@ -925,7 +979,8 @@ def collect_flat_sync_batch(
     length IS `num_steps`). With `telemetry` ([B]-leading), returns
     `(Rollout, Telemetry)`. `lane_shard` (static; a lane-axis
     `NamedSharding`) runs the collection SPMD over a dp mesh — see
-    `_flat_collect_single_eval`."""
+    `_flat_collect_single_eval`. `health` (static) ORs the in-JIT
+    sentinel mask into `telemetry.health_mask` per decision row."""
     ls = jax.vmap(init_loop_state)(states)
     out = _flat_collect_single_eval(
         params, bank, batch_policy_fn, rng, num_steps, ls,
@@ -933,7 +988,7 @@ def collect_flat_sync_batch(
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fns=None, rollout_duration=None,
         use_elapsed=False, telemetry=telemetry, lane_shard=lane_shard,
-        bulk_fused=bulk_fused,
+        bulk_fused=bulk_fused, health=health,
     )
     return (out[0], out[2]) if telemetry is not None else out[0]
 
@@ -942,7 +997,7 @@ def collect_flat_sync_batch(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "event_bulk", "bulk_events", "fulfill_bulk", "bulk_cycles",
-        "lane_shard", "bulk_fused",
+        "lane_shard", "bulk_fused", "health",
     ),
 )
 def collect_flat_async_batch(
@@ -964,6 +1019,7 @@ def collect_flat_async_batch(
     bulk_cycles: int = 1,
     lane_shard=None,
     bulk_fused: bool = True,
+    health: bool = False,
 ) -> tuple:
     """Single-eval flat equivalent of `vmap(collect_flat_async)`:
     persistent [B] lanes, fixed sim-time budget, group-shared mid-scan
@@ -1004,7 +1060,7 @@ def collect_flat_async_batch(
         fulfill_bulk=fulfill_bulk, bulk_cycles=bulk_cycles,
         reset_fns=reset_fns, rollout_duration=rollout_duration,
         use_elapsed=True, telemetry=telemetry, lane_shard=lane_shard,
-        bulk_fused=bulk_fused,
+        bulk_fused=bulk_fused, health=health,
     )
     ro, ls = out[0], out[1]
     ro = ro.replace(final_reset_count=reset_counts + ls.episodes)
@@ -1017,7 +1073,7 @@ def collect_flat_async_batch(
     jax.jit, static_argnums=(0, 2, 4),
     static_argnames=(
         "micro_groups", "event_burst", "event_bulk", "bulk_events",
-        "fulfill_bulk", "bulk_cycles", "bulk_fused",
+        "fulfill_bulk", "bulk_cycles", "bulk_fused", "health",
     ),
 )
 def collect_flat_async(
@@ -1040,6 +1096,7 @@ def collect_flat_async(
     fulfill_bulk: bool = False,
     bulk_cycles: int = 1,
     bulk_fused: bool = True,
+    health: bool = False,
 ) -> tuple:
     """Flat-engine equivalent of `collect_async`: persistent lanes with a
     fixed sim-time budget per iteration and mid-scan auto-resets drawn
@@ -1076,7 +1133,7 @@ def collect_flat_async(
         bulk_events=bulk_events, fulfill_bulk=fulfill_bulk,
         bulk_cycles=bulk_cycles, reset_fn=reset_fn,
         rollout_duration=rollout_duration, use_elapsed=True,
-        telemetry=telemetry, bulk_fused=bulk_fused,
+        telemetry=telemetry, bulk_fused=bulk_fused, health=health,
     )
     ro, ls = out[0], out[1]
     ro = ro.replace(final_reset_count=reset_count + ls.episodes)

@@ -35,11 +35,13 @@ over the mesh.
 from __future__ import annotations
 
 import abc
+import hashlib
 import json
 import os
 import os.path as osp
 import pathlib
 import shutil
+import time
 from typing import Any
 
 import jax
@@ -49,8 +51,14 @@ import optax
 from flax import serialization, struct
 
 from .. import metrics
-from ..config import EnvParams, env_params_from_cfg
+from ..config import HEALTH_KEYS, EnvParams, env_params_from_cfg
 from ..env import core
+from ..env.health import (
+    H_OOM,
+    H_STRAGGLER,
+    RETRYABLE_MASK,
+    describe_mask,
+)
 from ..obs import RunLog, emit
 from ..obs.memory import device_memory_stats
 from ..obs.telemetry import summarize, telemetry_zeros_like
@@ -123,7 +131,9 @@ class Trainer(abc.ABC):
 
     def __init__(self, agent_cfg: CfgType, env_cfg: CfgType,
                  train_cfg: CfgType, mesh=None,
-                 obs_cfg: CfgType | None = None) -> None:
+                 obs_cfg: CfgType | None = None,
+                 health_cfg: CfgType | None = None,
+                 chaos_cfg: CfgType | None = None) -> None:
         # TPU-friendly rbg PRNG for the whole training program (the env
         # hot loop draws several keys per micro-step; see
         # config.use_fast_prng). Must run before any key is created.
@@ -210,6 +220,65 @@ class Trainer(abc.ABC):
             "trace_dir", osp.join(self.artifacts_dir, "trace")
         )
         self._runlog: RunLog | None = None
+
+        # self-healing block (top-level `health:` YAML section, ISSUE 9):
+        #   enabled: true (default when the block is present) — thread
+        #     the in-JIT health sentinels through the rollout collectors
+        #     and the PPO update, and turn on automatic recovery (skip
+        #     the poisoned update in-JIT; on a tripped sentinel roll
+        #     back to the last-good state, reseed the iteration rng, and
+        #     retry with exponential backoff)
+        #   max_retries: 2 — rollback+retry budget per iteration; an
+        #     iteration still unhealthy past it raises (poisoned params
+        #     must never train on)
+        #   backoff_seconds: 1.0 — base of the exponential backoff
+        #   checkpoint_every: N — atomically save the full train state
+        #     every N iterations (0 = session end only), the preemption
+        #     half: a SIGKILLed window resumes from the last write
+        #   keep: 2 — checkpoint generations retained for the
+        #     corrupt-file fallback in `load_train_state`
+        #   straggler_ratio_max: float — quarantine (runlog `health`
+        #     record, no retry) iterations whose measured while-loop
+        #     straggler ratio exceeds this
+        # Enabling health forces telemetry threading (the mask rides the
+        # Telemetry carry) and disables the async-carry donation so a
+        # rolled-back iteration can re-collect from the pre-iteration
+        # lanes (one extra resident LoopState copy — the price of
+        # rollback).
+        hc = dict(health_cfg or {})
+        if set(hc) - HEALTH_KEYS:
+            raise ValueError(
+                "unknown health: config key(s) "
+                f"{sorted(set(hc) - HEALTH_KEYS)} — known keys: "
+                f"{sorted(HEALTH_KEYS)}"
+            )
+        self.health_enabled: bool = bool(
+            hc.get("enabled", health_cfg is not None)
+        )
+        self.health_max_retries: int = int(hc.get("max_retries", 2))
+        self.health_backoff: float = float(hc.get("backoff_seconds", 1.0))
+        self.health_checkpoint_every: int = int(
+            hc.get("checkpoint_every", 0)
+        )
+        self.checkpoint_keep: int = int(hc.get("keep", 2))
+        srm = hc.get("straggler_ratio_max")
+        self.health_straggler_max = None if srm is None else float(srm)
+        if self.health_enabled:
+            self.obs_telemetry = True
+
+        # deterministic fault injection (top-level `chaos:` YAML block;
+        # sparksched_tpu/chaos.py) — drills the recovery paths above
+        self._chaos = None
+        if chaos_cfg:
+            from ..chaos import ChaosMonkey
+
+            self._chaos = ChaosMonkey(chaos_cfg)
+            if self._chaos.any_scheduled() and not self.health_enabled:
+                emit(
+                    "[chaos] warning: chaos: faults scheduled without a "
+                    "health: block — injections will NOT be detected or "
+                    "recovered (this is only useful for negative tests)"
+                )
 
         # exactly one returns mode (reference trainer.py:63-74)
         assert ("reward_buff_cap" in train_cfg) ^ (
@@ -346,6 +415,10 @@ class Trainer(abc.ABC):
         # holding two copies of the largest resident state per device.
         self.mesh = mesh
         self._lane_sharding = None
+        # health rollback needs the pre-iteration async carry to stay
+        # valid after a (possibly poisoned) collect, so donation is off
+        # under the health block (see the health: comment above)
+        donate = () if self.health_enabled else (3,)
         if mesh is not None:
             from ..parallel import lane_sharding
 
@@ -361,7 +434,7 @@ class Trainer(abc.ABC):
             # through a replicated layout every iteration
             self._collect_jit = jax.jit(
                 self._collect, out_shardings=(lanes, lanes, lanes),
-                donate_argnums=(3,),
+                donate_argnums=donate,
             )
             self._update_jit = jax.jit(
                 self._update, in_shardings=(None, lanes),
@@ -369,7 +442,7 @@ class Trainer(abc.ABC):
             )
         else:
             self._collect_jit = jax.jit(
-                self._collect, donate_argnums=(3,)
+                self._collect, donate_argnums=donate
             )
             self._update_jit = jax.jit(self._update)
 
@@ -467,6 +540,7 @@ class Trainer(abc.ABC):
                     states, self.rollout_duration, seq_bases,
                     lane_salts, reset_counts, telem0,
                     lane_shard=self._lane_sharding,
+                    health=self.health_enabled,
                     **self.flat_batch_knobs,
                 )
                 ro, loop_states, telem = (
@@ -479,6 +553,7 @@ class Trainer(abc.ABC):
                         p, bank, policy_fn, k, self.rollout_steps, s,
                         self.rollout_duration, sb, salt, rc, tm,
                         micro_groups=self.flat_micro_groups,
+                        health=self.health_enabled,
                         **self.flat_knobs,
                     )
                 )(pol_rngs, states, seq_bases, lane_salts,
@@ -491,6 +566,7 @@ class Trainer(abc.ABC):
                 lambda k, s, sb, salt, rc, tm: collect_async(
                     p, bank, policy_fn, k, self.rollout_steps, s,
                     self.rollout_duration, sb, salt, rc, tm,
+                    health=self.health_enabled,
                 )
             )(pol_rngs, states, seq_bases, lane_salts, reset_counts,
               telem0)
@@ -507,6 +583,7 @@ class Trainer(abc.ABC):
                     jax.random.fold_in(rng, 7), self.rollout_steps,
                     states, telem0,
                     lane_shard=self._lane_sharding,
+                    health=self.health_enabled,
                     **self.flat_batch_knobs,
                 )
             elif flat:
@@ -514,13 +591,15 @@ class Trainer(abc.ABC):
                     lambda k, s, tm: collect_flat_sync(
                         p, bank, policy_fn, k, self.rollout_steps, s, tm,
                         micro_groups=self.flat_micro_groups,
+                        health=self.health_enabled,
                         **self.flat_knobs,
                     )
                 )(pol_rngs, states, telem0)
             else:
                 out = jax.vmap(
                     lambda k, s, tm: collect_sync(
-                        p, bank, policy_fn, k, self.rollout_steps, s, tm
+                        p, bank, policy_fn, k, self.rollout_steps, s, tm,
+                        health=self.health_enabled,
                     )
                 )(pol_rngs, states, telem0)
             ro, telem = out if track else (out, None)
@@ -580,9 +659,6 @@ class Trainer(abc.ABC):
         )
 
         for i in range(start, start + self.num_iterations):
-            state = state.replace(
-                rng=jax.random.fold_in(jax.random.PRNGKey(self.seed), i)
-            )
             # device trace: the obs-block iteration (absolute) wins; the
             # legacy profile_trace_dir traces the session's first
             # iteration's collect as before
@@ -592,22 +668,100 @@ class Trainer(abc.ABC):
                 trace_dir = self.profile_trace_dir
             else:
                 trace_dir = None
-            with Profiler(trace_dir, f"iter {i + 1} collect",
-                          quiet=not self.profiling, sink=sink) as p_col:
-                ro, self._env_states, telem = self._collect_jit(
-                    state.params, state.iteration, state.rng,
-                    self._env_states,
-                )
-                jax.block_until_ready(ro.reward)
             trace_upd = (
                 self.obs_trace_dir if i == self.obs_trace_iteration
                 else None
             )
-            prev_params = state.params
-            with Profiler(trace_upd, f"iter {i + 1} update",
-                          quiet=not self.profiling, sink=sink) as p_upd:
-                state, stats = self._update_jit(state, ro)
-                jax.block_until_ready(state.params)
+            # recovery loop (ISSUE 9): with `health:` off this runs the
+            # iteration exactly once with the pre-health rng derivation;
+            # with it on, a tripped sentinel rolls back to `last_good`
+            # (the pre-iteration TrainState and async carry — donation
+            # is off under health, so the carry stays valid), reseeds
+            # the iteration rng, and retries under exponential backoff.
+            last_good = state
+            prev_env_states = self._env_states
+            attempt = 0
+            while True:
+                rng_i = jax.random.fold_in(
+                    jax.random.PRNGKey(self.seed), i
+                )
+                if attempt:
+                    # reseeded retry: a fresh minibatch permutation and
+                    # policy-sampling stream for the re-run
+                    rng_i = jax.random.fold_in(rng_i, 90_000 + attempt)
+                state = last_good.replace(rng=rng_i)
+                try:
+                    with Profiler(trace_dir, f"iter {i + 1} collect",
+                                  quiet=not self.profiling,
+                                  sink=sink) as p_col:
+                        ro, env_states_new, telem = self._collect_jit(
+                            state.params, state.iteration, state.rng,
+                            prev_env_states,
+                        )
+                        jax.block_until_ready(ro.reward)
+                    if self._chaos is not None:
+                        ro, injected = self._chaos.poison_rollout(
+                            ro, i, attempt
+                        )
+                        telem, inj2 = self._chaos.inflate_straggler(
+                            telem, i, attempt
+                        )
+                        injected += inj2
+                        if injected and self._runlog is not None:
+                            self._runlog.write(
+                                "chaos", iteration=i, attempt=attempt,
+                                injected=injected,
+                            )
+                        self._chaos.maybe_sigkill(i)
+                        self._chaos.maybe_raise_oom(i, attempt)
+                    prev_params = state.params
+                    with Profiler(trace_upd, f"iter {i + 1} update",
+                                  quiet=not self.profiling,
+                                  sink=sink) as p_upd:
+                        state, stats = self._update_jit(state, ro)
+                        jax.block_until_ready(state.params)
+                except Exception as e:
+                    if not (self.health_enabled
+                            and "RESOURCE_EXHAUSTED" in str(e)):
+                        raise
+                    if not self._record_health_and_retry(
+                        i, attempt, H_OOM, detail=str(e)[:300]
+                    ):
+                        raise
+                    attempt += 1
+                    continue
+                tsum = summarize(telem) if telem is not None else None
+                health_mask = 0
+                if self.health_enabled:
+                    if tsum is not None:
+                        health_mask |= int(tsum.get("health_mask", 0))
+                    hm_stat = stats.get("health_mask")
+                    if hm_stat is not None:
+                        health_mask |= int(hm_stat)
+                    if (self.health_straggler_max is not None
+                            and tsum is not None
+                            and tsum["straggler_ratio"]
+                            > self.health_straggler_max):
+                        health_mask |= H_STRAGGLER
+                if health_mask & RETRYABLE_MASK:
+                    if not self._record_health_and_retry(
+                        i, attempt, health_mask
+                    ):
+                        raise RuntimeError(
+                            f"iteration {i + 1} still unhealthy "
+                            f"({describe_mask(health_mask)}) after "
+                            f"{attempt} retr"
+                            f"{'y' if attempt == 1 else 'ies'} — "
+                            "refusing to train on a poisoned state"
+                        )
+                    attempt += 1
+                    continue
+                if health_mask:  # non-retryable bits (straggler):
+                    # quarantine the observation, keep the iteration
+                    self._record_health(i, attempt, health_mask,
+                                        action="quarantine")
+                break
+            self._env_states = env_states_new
             state = state.replace(iteration=state.iteration + 1)
 
             roll_stats = self._rollout_stats(ro)
@@ -630,12 +784,15 @@ class Trainer(abc.ABC):
 
             host_stats = {
                 k: float(v) for k, v in stats.items()
-                if v is not None and k != "avg_num_jobs_est"
+                if v is not None
+                and k not in ("avg_num_jobs_est", "health_mask")
             }
             host_stats["collect_seconds"] = p_col.elapsed
             host_stats["update_seconds"] = p_upd.elapsed
-            if telem is not None:
-                tsum = summarize(telem)
+            if self.health_enabled:
+                host_stats["health_mask"] = float(health_mask)
+                host_stats["health_retries"] = float(attempt)
+            if tsum is not None:
                 if self._runlog is not None:
                     self._runlog.telemetry(tsum, iteration=i)
                 host_stats["straggler_ratio"] = tsum["straggler_ratio"]
@@ -660,12 +817,71 @@ class Trainer(abc.ABC):
                         if mem.get(src) is not None:
                             host_stats[dst] = mem[src]
             self._write_stats(i, host_stats | roll_stats)
+            # preemption safety (ISSUE 9): an atomic full-train-state
+            # write every N iterations, so a SIGKILLed window resumes
+            # from the last completed iteration instead of the session
+            # start (the end-of-session save in _cleanup never runs
+            # under SIGKILL)
+            if (self.health_enabled and self.health_checkpoint_every
+                    and (i + 1) % self.health_checkpoint_every == 0):
+                self.save_train_state(
+                    state,
+                    osp.join(self.artifacts_dir, "train_state.msgpack"),
+                )
             emit(
                 f"Iteration {i + 1} complete. Avg. # jobs: "
                 f"{avg_num_jobs:.3f}"
             )
         self._cleanup(state)
         return state
+
+    # ------------------------------------------------------------------
+    # health recording / recovery policy (ISSUE 9)
+    # ------------------------------------------------------------------
+
+    def _record_health(self, i: int, attempt: int, mask: int,
+                       action: str, **fields: Any) -> None:
+        """One runlog `health` record (the quarantine marker): the raw
+        bitmask, its decoded bit names, and what the trainer did about
+        it."""
+        bits = describe_mask(mask)
+        if self._runlog is not None:
+            self._runlog.health(
+                mask, iteration=i, attempt=attempt, action=action,
+                **fields,
+            )
+        emit(
+            f"[health] iteration {i + 1} attempt {attempt}: "
+            f"{bits or [hex(mask)]} -> {action}"
+        )
+
+    def _record_health_and_retry(self, i: int, attempt: int, mask: int,
+                                 **fields: Any) -> bool:
+        """Record a tripped sentinel and decide the retry: True means
+        "rolled back, backoff slept, caller should re-run the
+        iteration"; False means the retry budget is exhausted."""
+        if attempt >= self.health_max_retries:
+            self._record_health(i, attempt, mask, action="gave_up",
+                                **fields)
+            if self._runlog is not None:
+                self._runlog.write(
+                    "recovery", iteration=i, attempt=attempt,
+                    action="gave_up", mask=int(mask),
+                    bits=describe_mask(mask),
+                )
+            return False
+        delay = self.health_backoff * (2.0 ** attempt)
+        self._record_health(i, attempt, mask, action="rollback_retry",
+                            backoff_seconds=round(delay, 3), **fields)
+        if self._runlog is not None:
+            self._runlog.write(
+                "recovery", iteration=i, attempt=attempt,
+                action="rollback_retry", mask=int(mask),
+                bits=describe_mask(mask),
+                backoff_seconds=round(delay, 3),
+            )
+        time.sleep(delay)
+        return True
 
     # ------------------------------------------------------------------
     # stats / io
@@ -764,51 +980,144 @@ class Trainer(abc.ABC):
         with open(osp.join(d, "state.json"), "w") as fp:
             json.dump(meta, fp)
 
-    def save_train_state(self, state: TrainState, path: str) -> None:
-        # tmp + atomic rename: session loops get killed (watchdogs,
-        # chip handover) and a truncated in-place write would poison
-        # every later resume
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as fp:
-            fp.write(serialization.to_bytes(jax.device_get(state)))
-        os.replace(tmp, path)
+    def save_train_state(self, state: TrainState, path: str,
+                         keep: int | None = None) -> None:
+        """Atomic, digest-stamped, keep-last-K train-state write
+        (ISSUE 9 satellite): serialize, fsync a tmp file, rotate the
+        previous generations (`path.1` = previous, `path.2` = the one
+        before, up to `keep - 1` — state and meta move together), then
+        `os.replace` into place. A kill at ANY point leaves either the
+        old complete generation set or the new one; a torn write can
+        only ever hit the tmp file, never a named generation."""
+        keep = self.checkpoint_keep if keep is None else int(keep)
+        data = serialization.to_bytes(jax.device_get(state))
         # the checkpointed rng key's layout depends on the PRNG impl
-        # (threefry uint32[2] vs rbg uint32[4], see config.use_fast_prng);
+        # (threefry uint32[2] vs rbg uint32[4], config.use_fast_prng);
         # stamp the impl so a resume under the wrong `fast_prng` setting
-        # fails with an error that names the flag instead of an opaque
-        # flax shape mismatch (tmp+replace for the same kill-safety as
-        # the state file)
-        meta_tmp = path + ".meta.json.tmp"
-        with open(meta_tmp, "w") as fp:
-            json.dump(
-                {"prng_impl": str(jax.config.jax_default_prng_impl)}, fp
-            )
-        os.replace(meta_tmp, path + ".meta.json")
+        # fails with an error naming the flag instead of an opaque flax
+        # shape mismatch. sha256 is the torn-write detector: a load
+        # whose bytes don't match falls back to the previous generation.
+        meta = {
+            "prng_impl": str(jax.config.jax_default_prng_impl),
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "iteration": int(state.iteration),
+        }
+
+        def fsync_write(target: str, payload: bytes | str,
+                        mode: str) -> None:
+            tmp = target + ".tmp"
+            with open(tmp, mode) as fp:
+                fp.write(payload)
+                fp.flush()
+                os.fsync(fp.fileno())
+            os.replace(tmp, target)
+
+        def intact(gen: str) -> bool:
+            """Digest check of one on-disk generation; generations
+            without a digest (legacy) pass."""
+            meta_p = gen + ".meta.json"
+            if not osp.exists(meta_p):
+                return True
+            try:
+                with open(meta_p) as fp:
+                    want = json.load(fp).get("sha256")
+                if want is None:
+                    return True
+                with open(gen, "rb") as fp:
+                    return hashlib.sha256(
+                        fp.read()
+                    ).hexdigest() == want
+            except (OSError, ValueError):
+                return False
+
+        # rotate existing generations oldest-first (gen g -> g+1) —
+        # but NEVER promote a torn generation over an intact one: after
+        # a crash-recovery resume, `path` may be the very corrupt file
+        # the loader fell back past, and rotating it onto `path.1`
+        # would destroy the only good copy right before the (killable)
+        # write below
+        for g in range(keep - 1, 0, -1):
+            src = path if g == 1 else f"{path}.{g - 1}"
+            if not osp.exists(src):
+                continue
+            if not intact(src):
+                emit(
+                    f"[checkpoint] discarding torn generation {src} "
+                    "instead of rotating it over an intact one"
+                )
+                os.remove(src)
+                if osp.exists(src + ".meta.json"):
+                    os.remove(src + ".meta.json")
+                continue
+            os.replace(src, f"{path}.{g}")
+            if osp.exists(src + ".meta.json"):
+                os.replace(
+                    src + ".meta.json", f"{path}.{g}.meta.json"
+                )
+        fsync_write(path, data, "wb")
+        fsync_write(path + ".meta.json", json.dumps(meta), "w")
 
     def load_train_state(self, path: str) -> TrainState:
+        """Verified load with corrupt-file fallback (ISSUE 9): check
+        the meta digest, deserialize, and on a torn/corrupt generation
+        fall back to the previous one (`path.1`, `path.2`, ...),
+        emitting + runlogging what was skipped. A PRNG-impl mismatch
+        raises immediately — that is a config error on THIS process,
+        not file corruption, and every generation shares it."""
         current = str(jax.config.jax_default_prng_impl)
-        meta_path = path + ".meta.json"
-        if osp.exists(meta_path):
-            with open(meta_path) as fp:
-                saved = json.load(fp).get("prng_impl", current)
-            if saved != current:
-                raise ValueError(
-                    f"train state {path} was saved under PRNG impl "
-                    f"{saved!r} but this process uses {current!r} — set "
-                    f"`fast_prng: {saved == 'rbg'}` in the trainer config "
-                    "(config.use_fast_prng switches the impl) before "
-                    "resuming"
-                )
         template = self.init_state()
-        with open(path, "rb") as fp:
+        candidates = [path] + [
+            f"{path}.{g}" for g in range(1, max(self.checkpoint_keep, 2))
+        ]
+        errors: list[str] = []
+        for cand in candidates:
+            if not osp.exists(cand):
+                continue
+            meta_path = cand + ".meta.json"
+            digest = None
+            if osp.exists(meta_path):
+                with open(meta_path) as fp:
+                    meta = json.load(fp)
+                saved = meta.get("prng_impl", current)
+                if saved != current:
+                    raise ValueError(
+                        f"train state {cand} was saved under PRNG impl "
+                        f"{saved!r} but this process uses {current!r} — "
+                        f"set `fast_prng: {saved == 'rbg'}` in the "
+                        "trainer config (config.use_fast_prng switches "
+                        "the impl) before resuming"
+                    )
+                digest = meta.get("sha256")
+            with open(cand, "rb") as fp:
+                data = fp.read()
+            if digest is not None and (
+                hashlib.sha256(data).hexdigest() != digest
+            ):
+                errors.append(f"{cand}: sha256 mismatch (torn write?)")
+                continue
             try:
-                return serialization.from_bytes(template, fp.read())
-            except ValueError as e:
-                raise ValueError(
-                    f"could not restore {path}: {e} — if the error is a "
-                    "shape mismatch on `rng`, the state was saved under a "
-                    "different PRNG impl (trainer config `fast_prng`)"
-                ) from e
+                restored = serialization.from_bytes(template, data)
+            except (ValueError, KeyError) as e:
+                errors.append(f"{cand}: {e}")
+                continue
+            if errors:
+                emit(
+                    f"[checkpoint] fell back to {cand} — skipped: "
+                    + "; ".join(errors)
+                )
+                if self._runlog is not None:
+                    self._runlog.write(
+                        "recovery", action="checkpoint_fallback",
+                        loaded=cand, skipped=errors,
+                    )
+            return restored
+        raise ValueError(
+            f"could not restore {path}: no intact generation among "
+            f"{candidates} ({'; '.join(errors) or 'none found'}) — if "
+            "the error is a shape mismatch on `rng`, the state was "
+            "saved under a different PRNG impl (trainer config "
+            "`fast_prng`)"
+        )
 
     def _write_stats(self, i: int, stats: dict[str, float]) -> None:
         """Per-iteration scalars: runlog JSONL (default sink) + the
@@ -842,4 +1151,6 @@ def make_trainer(cfg: CfgType) -> Trainer:
         cfg["agent"], cfg["env"], cfg["trainer"],
         mesh=mesh_from_config(cfg.get("parallel")),
         obs_cfg=cfg.get("obs"),
+        health_cfg=cfg.get("health"),
+        chaos_cfg=cfg.get("chaos"),
     )

@@ -24,9 +24,12 @@ EPS = 1e-8
 class VPG(Trainer):
     def __init__(self, agent_cfg: CfgType, env_cfg: CfgType,
                  train_cfg: CfgType, mesh=None,
-                 obs_cfg: CfgType | None = None) -> None:
+                 obs_cfg: CfgType | None = None,
+                 health_cfg: CfgType | None = None,
+                 chaos_cfg: CfgType | None = None) -> None:
         super().__init__(agent_cfg, env_cfg, train_cfg, mesh=mesh,
-                         obs_cfg=obs_cfg)
+                         obs_cfg=obs_cfg, health_cfg=health_cfg,
+                         chaos_cfg=chaos_cfg)
         self.entropy_coeff = train_cfg.get("entropy_coeff", 0.0)
 
     def _update(self, state: TrainState, ro: Rollout):

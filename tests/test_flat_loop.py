@@ -204,6 +204,13 @@ def test_telemetry_parity_core_vs_flat():
     # so its drain counter stays zero by construction
     assert sum_core["drain_iters_mean"] > 0
     assert sum_flat["drain_iters_mean"] == 0
+    # ISSUE 9 health-bitmask field: engines without health threading
+    # report an all-zero mask and agree — the collector-level
+    # health=True parity (clean episodes still zero, still agreeing)
+    # is tests/test_health.py::test_health_mask_parity_core_vs_flat...
+    assert sum_core["health_mask"] == sum_flat["health_mask"] == 0
+    assert sum_core["health_bits"] == sum_flat["health_bits"] == []
+    assert sum_flat["unhealthy_lanes"] == 0
 
 
 @pytest.mark.slow

@@ -51,7 +51,9 @@ def test_config_loader(tmp_path):
     cfg_path = osp.join("/root/repo", "config", "decima_tpch.yaml")
     with open(cfg_path) as fp:
         cfg = yaml.safe_load(fp)
-    assert set(cfg) == {"trainer", "agent", "env", "obs"}
+    # `health:` (ISSUE 9) ships enabled in the flagship config — the
+    # self-healing runtime is the default for unattended chip windows
+    assert set(cfg) == {"trainer", "agent", "env", "obs", "health"}
     params = env_params_from_cfg(cfg["env"])
     assert params.num_executors == 50
     assert params.max_jobs == 200  # from job_arrival_cap

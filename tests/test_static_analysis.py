@@ -40,6 +40,11 @@ def test_shipped_tree_is_analysis_clean():
         "observe", "micro_step", "decide_micro_step",
         "drain_to_decision", "decima_score", "decima_batch_policy",
         "ppo_update", "flat_collect_batch",
+        # ISSUE 9: the `health:`-on production programs, budgeted
+        # separately so the sentinel cost is capped while the
+        # default-off programs above pin that health off changes
+        # nothing
+        "ppo_update_health", "flat_collect_batch_health",
     }
     assert set(report["passes"]["jaxpr"]["measured"]) == all_programs
     mem = report["passes"]["memory"]["measured"]
