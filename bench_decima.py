@@ -357,6 +357,229 @@ def bench_inference(
     print(json.dumps(row), flush=True)
 
 
+def _latency_block(samples_ms: list[float], reps: int) -> dict:
+    """The `latency` row's percentile block (PERF.md round 13 schema):
+    per-decision wall-time percentiles over `reps` timed calls."""
+    import numpy as np
+
+    a = np.asarray(samples_ms, dtype=np.float64)
+    return {
+        "p50_ms": round(float(np.percentile(a, 50)), 4),
+        "p90_ms": round(float(np.percentile(a, 90)), 4),
+        "p99_ms": round(float(np.percentile(a, 99)), 4),
+        "mean_ms": round(float(a.mean()), 4),
+        "max_ms": round(float(a.max()), 4),
+        "reps": int(reps),
+    }
+
+
+def _on_chip_block() -> dict:
+    """On-chip-only latency-row fields, guarded with the established
+    UNAVAILABLE marker so CPU rows are complete and self-describing
+    (the MULTICHIP_r*.json `real_mesh` pattern): allocator stats exist
+    only on the real backend; chip-session stage 14 fills them."""
+    from sparksched_tpu.obs.memory import device_memory_stats
+
+    stats = device_memory_stats()
+    if stats is None:
+        return {
+            "device_memory": (
+                "UNAVAILABLE: no allocator stats on this backend "
+                "(CPU run); chip-session stage 14 records the "
+                "on-chip values"
+            ),
+        }
+    return {"device_memory": stats}
+
+
+def bench_serve_latency(
+    capacity: int | None = None,
+    max_batch: int | None = None,
+    reps: int | None = None,
+    artifact: str = "artifacts/serve_latency_r10.json",
+) -> list[dict]:
+    """Decision-serving latency (ISSUE 10): p50/p90/p99 per-decision
+    wall time through the AOT session store (`sparksched_tpu/serve/`),
+    batch=1 (unbatched donated program) vs batch=K (one compiled
+    width-K call), plus the micro-batcher's bounded-linger sweep and
+    the cold-start cost (AOT lower+compile + first dispatch). Each
+    configuration prints one `latency` JSON row; the full set is also
+    written to `artifact` with the protocol metadata. Percentiles are
+    over per-call wall times (median-of-reps protocol: the timed
+    window is `reps` sequential calls on a warm store, so p50 is the
+    steady-state figure and p99 the scheduling-jitter tail)."""
+    capacity = capacity if capacity is not None else int(
+        os.environ.get("SERVE_BENCH_CAPACITY", 64)
+    )
+    max_batch = max_batch if max_batch is not None else int(
+        os.environ.get("SERVE_BENCH_BATCH", 8)
+    )
+    reps = reps if reps is not None else int(
+        os.environ.get("SERVE_BENCH_REPS", 150)
+    )
+    lingers = [
+        float(x) for x in os.environ.get(
+            "SERVE_BENCH_LINGER_MS", "0,2"
+        ).split(",") if x.strip()
+    ]
+    from sparksched_tpu.obs.runlog import RunLog
+    from sparksched_tpu.serve import MicroBatcher, SessionStore
+
+    params = EnvParams(
+        num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
+        moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
+        mean_time_limit=None,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    if bank.max_stages != params.max_stages:
+        params = params.replace(
+            max_stages=bank.max_stages, max_levels=bank.max_stages
+        )
+    sched = DecimaScheduler(
+        num_executors=params.num_executors,
+        embed_dim=16,
+        gnn_mlp_kwargs={
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+        job_bucket=16,  # the PR-3 CPU calibration winner
+    )
+    runlog = RunLog.create("artifacts", name=None)
+    t0 = time.perf_counter()
+    store = SessionStore(
+        params, bank, sched, capacity=capacity, max_batch=max_batch,
+        deterministic=True, seed=0, runlog=runlog,
+    )
+    cold_start_s = time.perf_counter() - t0
+
+    def fresh_sessions(n: int) -> list[int]:
+        return [store.create(seed=1000 + i) for i in range(n)]
+
+    sids = fresh_sessions(max_batch)
+    base_cfg = {
+        "capacity": capacity,
+        "max_batch": max_batch,
+        "engine": "serve",
+        "deterministic": True,
+        "donated": store.donate,
+        "job_bucket": sched.job_bucket,
+        "dtype": bank_dtype_label(bank),
+        "obs_dtype": params.obs_dtype,
+        "prng_impl": str(jax.config.jax_default_prng_impl),
+        "backend": jax.default_backend(),
+    }
+    cold = {
+        "cold_start_s": round(cold_start_s, 3),
+        "compile_decide_s": round(store.compile_secs["decide"], 3),
+        "compile_decide_batch_s": round(
+            store.compile_secs["decide_batch"], 3
+        ),
+        "warmup_s": round(store.warmup_secs, 4),
+    }
+    rows: list[dict] = []
+
+    def emit(metric: str, samples_ms: list[float], cfg_extra: dict
+             ) -> None:
+        lat = _latency_block(samples_ms, len(samples_ms)) | cold
+        if cfg_extra.get("batch", 1) > 1:
+            lat["per_decision_p50_ms"] = round(
+                lat["p50_ms"] / cfg_extra["batch"], 4
+            )
+        row = {
+            "metric": metric,
+            "value": lat["p50_ms"],
+            "unit": "ms",
+            "latency": lat,
+            "analysis_clean": analysis_clean_stamp(),
+            "config": base_cfg | cfg_extra,
+            "on_chip": _on_chip_block(),
+        }
+        rows.append(row)
+        runlog.latency(lat, batch=cfg_extra.get("batch"), metric=metric)
+        print(json.dumps(row), flush=True)
+
+    # --- batch=1: the unbatched donated AOT path (a dedicated
+    # session, so an episode ending mid-window never touches the
+    # batch set served below) ---
+    one = store.create(seed=3000)
+    samples = []
+    for i in range(reps):
+        t1 = time.perf_counter()
+        r = store.decide(one)
+        samples.append((time.perf_counter() - t1) * 1e3)
+        # rotate a finished OR quarantined session (a tripped health
+        # mask means the NEXT decide would raise — on-chip, where
+        # sentinels actually fire, the artifact must survive it)
+        if r.done or r.health_mask:
+            store.close(one)
+            one = store.create(seed=4000 + i)
+    store.close(one)
+    emit("serve_decide_latency_batch1", samples, {"batch": 1})
+
+    # --- batch=K: one compiled width-K call per timed rep ---
+    samples = []
+    for i in range(reps):
+        t1 = time.perf_counter()
+        results = store.decide_batch(sids)
+        samples.append((time.perf_counter() - t1) * 1e3)
+        if any(r.done or r.health_mask for r in results):
+            for s in sids:
+                store.close(s)
+            sids = fresh_sessions(max_batch)
+    emit(
+        f"serve_decide_latency_batch{max_batch}", samples,
+        {"batch": max_batch},
+    )
+
+    # --- bounded-linger sweep: one lone request through the batcher;
+    # its latency is the linger window (waiting for co-riders that
+    # never come) plus the flush's decision call — the worst case the
+    # linger knob can add to a request ---
+    for linger_ms in lingers:
+        mb = MicroBatcher(store, linger_ms=linger_ms)
+        lone = store.create(seed=5000)
+        samples = []
+        for i in range(max(10, reps // 5)):
+            tk = mb.submit(lone)
+            while not tk.ready:
+                mb.poll()
+            samples.append(
+                (time.perf_counter() - tk.submitted_at) * 1e3
+            )
+            # rotate a finished/failed/quarantined session so the
+            # sweep never times a frozen lane (and a quarantine fails
+            # one ticket, not the artifact)
+            if (tk.result is None or tk.result.done
+                    or tk.result.health_mask):
+                store.close(lone)
+                lone = store.create(seed=5100 + i)
+        store.close(lone)
+        emit(
+            f"serve_batcher_latency_linger{linger_ms:g}ms", samples,
+            {"batch": 1, "linger_ms": linger_ms, "front": "batcher"},
+        )
+
+    os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+    with open(artifact, "w") as fp:
+        json.dump({
+            "protocol": {
+                "reps": reps,
+                "timing": "per-call wall time on a warm store; "
+                          "percentiles over reps sequential calls",
+                "cold_start": "AOT lower+compile (both programs) + "
+                              "first-dispatch warmup",
+                "linger_sweep_ms": lingers,
+            },
+            "rows": rows,
+        }, fp, indent=1)
+    runlog.close()
+    print(f"# bench_decima: wrote {artifact} ({len(rows)} rows)",
+          file=sys.stderr, flush=True)
+    return rows
+
+
 def bench_ppo(
     num_envs: int = 1024, rollout_steps: int = 256,
     compute_dtype: str | None = None, engine: str = "core",
@@ -527,3 +750,9 @@ if __name__ == "__main__":
         compute_dtype="bfloat16",
     )
     bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps, engine="flat")
+    # ISSUE 10: decision-serving latency rows (p50/p99, batch=1 vs
+    # batch=K, cold start + linger sweep) through the AOT session
+    # store; SERVE_BENCH=0 skips (the rows also run standalone from
+    # chip-session stage 14 at the 1024-session scale)
+    if os.environ.get("SERVE_BENCH", "1") == "1":
+        bench_serve_latency()

@@ -514,6 +514,63 @@ def stage_fused_headline():
             break
 
 
+def stage_serve_latency():
+    """ISSUE 10: on-chip decision-serving latency capture — the
+    1024-session AOT store served at batch=1 and batch=K, p50/p99 per
+    decision plus the cold-start (AOT compile) cost, written as
+    `latency` rows + artifacts/serve_latency_r10.json. Runs ENTIRELY
+    in a subprocess, gate included (counting devices claims the
+    client); a chipless host prints an explicit
+    `[serve-latency] UNAVAILABLE` marker and exits 0 — the watcher log
+    must distinguish "no window" from "never ran". The CPU latency
+    table at the default 64-session scale lives in PERF.md round 13;
+    this stage is the on-chip confirmation slot."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    if _client_held():
+        print("[serve-latency] parent process already holds a device "
+              "client; run stage 14 as its own invocation", flush=True)
+        return
+    repo = osp.dirname(osp.abspath(__file__))
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from sparksched_tpu.config import (\n"
+        "    enable_compilation_cache, honor_jax_platforms_env,\n"
+        "    use_fast_prng,\n"
+        ")\n"
+        "honor_jax_platforms_env()\n"
+        "enable_compilation_cache()\n"
+        "if os.environ.get('BENCH_PRNG', 'rbg') == 'rbg':\n"
+        "    use_fast_prng()\n"
+        "import jax\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    print('[serve-latency] UNAVAILABLE: cpu backend only; "
+        "the 1024-session serving-latency rows need a chip window "
+        "(the CPU latency table is recorded in PERF.md round 13)', "
+        "flush=True)\n"
+        "    sys.exit(0)\n"
+        "import bench_decima\n"
+        "bench_decima.bench_serve_latency()\n"
+    )
+    env = os.environ | {
+        # the chip-scale store: 1024 live sessions, the batched
+        # program at the width-K compaction bucket
+        "SERVE_BENCH_CAPACITY": os.environ.get(
+            "SERVE_BENCH_CAPACITY", "1024"
+        ),
+        "SERVE_BENCH_BATCH": os.environ.get("SERVE_BENCH_BATCH", "16"),
+        "SERVE_BENCH_REPS": os.environ.get("SERVE_BENCH_REPS", "300"),
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, timeout=2700, env=env,
+    )
+    print(f"[serve-latency] subprocess rc={r.returncode}", flush=True)
+
+
 # ---------------------------------------------------------------------------
 # stage-completion ledger (ISSUE 9 preemption safety)
 # ---------------------------------------------------------------------------
@@ -588,6 +645,7 @@ STAGES = {
     "11": ("on-chip memory capture", stage_memory_capture),
     "12": ("sharded multichip bench", stage_multichip_bench),
     "13": ("fused-engine headline bench", stage_fused_headline),
+    "14": ("serving-latency capture", stage_serve_latency),
 }
 
 
@@ -621,10 +679,10 @@ if __name__ == "__main__":
                 print("chip unavailable; aborting session", flush=True)
                 break
         finally:
-            # 7, 12 and 13 run in subprocesses and 10 is
+            # 7, 12, 13 and 14 run in subprocesses and 10 is
             # CPU-subprocess-only: none takes the in-process device
             # client
-            if p not in ("7", "10", "12", "13"):
+            if p not in ("7", "10", "12", "13", "14"):
                 _mark_client_held()
             if ledger_path:
                 ledger[p] = {

@@ -31,6 +31,8 @@ rm -f /tmp/memcap_done
 rm -f /tmp/multichip_done
 # ... and for the fused-engine headline row (stage 13, ISSUE 7)
 rm -f /tmp/fused_headline_done
+# ... and for the serving-latency capture (stage 14, ISSUE 10)
+rm -f /tmp/serve_latency_done
 # stage-completion ledger (ISSUE 9): per-LIFETIME like the markers
 # above — a restarted watcher must re-run its multi-stage sessions, not
 # inherit a previous lifetime's completions (the ledger's job is
@@ -236,6 +238,21 @@ print('ALIVE')
       echo "fused-headline rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
       grep -q '"backend": "tpu"' /tmp/fused_headline_last.log \
         && touch "$FUSED_MARK"
+    fi
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time serving-latency capture (ISSUE 10, stage 14): the
+    # 1024-session AOT store's batch=1 and batch=K p50/p99 rows — the
+    # on-chip partner of the CPU latency table in PERF.md round 13.
+    # Once per watcher lifetime; marked done only when a TPU-backed
+    # row landed (an UNAVAILABLE marker means no window yet — retry
+    # next loop, like the stage-13 slot).
+    SERVE_MARK=/tmp/serve_latency_done
+    if [ ! -f "$SERVE_MARK" ]; then
+      timeout -k 60 2700 python scripts_chip_session.py 14 \
+        | tee /tmp/serve_latency_last.log
+      echo "serve-latency rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q '"backend": "tpu"' /tmp/serve_latency_last.log \
+        && touch "$SERVE_MARK"
     fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable

@@ -10,8 +10,9 @@ package makes TPU-hostility a CI failure, via three passes:
 - `jaxpr_audit`: traces the registered hot programs (`observe`,
   `micro_step`, `decide_micro_step`, `drain_to_decision`,
   `DecimaScheduler.score`/`batch_policy`, `ppo_update`,
-  `flat_collect_batch`, plus the `health:`-instrumented
-  `ppo_update_health`/`flat_collect_batch_health` variants) with
+  `flat_collect_batch`, the `health:`-instrumented
+  `ppo_update_health`/`flat_collect_batch_health` variants, plus the
+  AOT serving programs `serve_decide`/`serve_decide_batch`) with
   audit-config shapes and checks each jaxpr rule-by-rule — no host
   callbacks outside an explicit allowlist, no f64/i64 anywhere,
   loop-free programs stay free of `while`/`scan`, and per-program

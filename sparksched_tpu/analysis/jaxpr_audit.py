@@ -165,6 +165,20 @@ BUDGETS: dict[str, Budget] = {
     "flat_collect_batch_health": Budget(
         eqn_lo=9000, eqn_hi=17200, gather_hi=257, scatter_hi=27,
     ),
+    # ISSUE 10: the AOT decision-serving programs (serve/aot.py),
+    # pinned 2026-08-04 — serve_decide 6514/33/65, serve_decide_batch
+    # 12853/251/65 (store capacity 8 / batch 4 at audit scale). The
+    # high scatter count is structural: the store scatter-back writes
+    # each of the ~50 LoopState leaves at the served slot(s) — one
+    # dynamic-update per leaf, in-place under donation. The while is
+    # `drain_to_decision` (the inter-decision drain, by design); the
+    # scan is the GNN level pass + the bulk event kernel.
+    "serve_decide": Budget(
+        eqn_lo=3000, eqn_hi=8800, gather_hi=45, scatter_hi=88,
+    ),
+    "serve_decide_batch": Budget(
+        eqn_lo=6000, eqn_hi=17400, gather_hi=339, scatter_hi=88,
+    ),
 }
 
 
@@ -514,6 +528,18 @@ def program_callables(names: tuple[str, ...] | None = None
             out["decima_batch_policy"] = (
                 lambda r, o: sched.batch_policy(r, o), (key, obs_b)
             )
+
+    if want is None or want & {"serve_decide", "serve_decide_batch"}:
+        # ISSUE 10: the AOT decision service's two programs (serving
+        # store capacity 8, micro-batch width 4 at audit scale; the
+        # production programs differ only in buffer widths). Traced
+        # here exactly as `serve/aot.py` lowers them, so the audited
+        # jaxpr IS the compiled serving program.
+        from ..serve.aot import serve_callables
+
+        for name, entry in serve_callables().items():
+            if want is None or name in want:
+                out[name] = entry
 
     if want is None or "ppo_update" in want:
         out["ppo_update"] = ppo_update_callable()

@@ -50,8 +50,14 @@ from . import Violation
 HOT_DIRS = ("env", "schedulers")
 
 # host adapters by contract — they exist to bridge device pytrees to
-# host consumers, so host-scalar/host-sync/time rules do not apply
-HOST_FILES = frozenset({"renderer.py", "env/gym_compat.py"})
+# host consumers, so host-scalar/host-sync/time rules do not apply.
+# serve/session.py is the decision-serving request/response boundary
+# (ISSUE 10): its device_get/block_until_ready ARE the product — the
+# caller is handed a concrete decision — and its traced code lives in
+# serve/aot.py + env/, which the jaxpr rules audit directly.
+HOST_FILES = frozenset({
+    "renderer.py", "env/gym_compat.py", "serve/session.py",
+})
 
 # host-side entry points inside otherwise-hot modules, PATH-QUALIFIED
 # (a bare-name exemption would let any function named `schedule` in a

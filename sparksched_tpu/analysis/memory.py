@@ -139,6 +139,15 @@ MEM_BUDGETS: dict[str, MemBudget] = {
     # breach this long before it OOMs a chip.
     "ppo_update_health": MemBudget(temp_hi=365 * MB),
     "flat_collect_batch_health": MemBudget(temp_hi=450 * MB),
+    # ISSUE 10 serving programs (pinned 2026-08-04): serve_decide
+    # 59.0 MB, serve_decide_batch 325.5 MB at the audit store/batch
+    # shapes. The byte budget is the serving-latency analog of the
+    # round-5 OOM lesson: a serve-path change that starts
+    # materializing store-sized temporaries (the donation exists so
+    # steady-state decisions allocate nothing store-shaped) breaches
+    # this band long before it shows up as a p99 regression on-chip.
+    "serve_decide": MemBudget(temp_hi=80 * MB),
+    "serve_decide_batch": MemBudget(temp_hi=440 * MB),
 }
 
 # lane counts the advisor sweeps (the bench's production range; 1024
@@ -333,6 +342,22 @@ def audit_memory(
                 None,
             ),
         }
+
+    # -- serving batch program (ISSUE 10): the bank-broadcast rule on
+    # its native micro-batch axis. `serve/aot.py` vmaps apply_and_drain
+    # over the K gathered sessions, so a bank access slipping into a
+    # lane-dependent cond/switch branch would materialize one bank
+    # copy per in-flight request — the same 19.4 GB hazard class,
+    # caught here on CPU before a serving deploy ever sees it. (No
+    # lane-fit: the serve batch width is a latency knob bounded by
+    # max_batch, not a throughput axis swept to HBM capacity.)
+    if names is None or "serve_decide_batch" in names:
+        from ..serve.aot import SERVE_AUDIT_BATCH
+
+        found.extend(check_bank_broadcast(
+            "serve_decide_batch", programs["serve_decide_batch"], bank,
+            SERVE_AUDIT_BATCH,
+        ))
     return found, measured
 
 

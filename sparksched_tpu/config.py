@@ -135,6 +135,18 @@ HEALTH_KEYS = frozenset({
     "straggler_ratio_max",  # quarantine threshold (no retry)
 })
 
+SERVE_KEYS = frozenset({
+    # ISSUE 10: the top-level `serve:` block — the AOT decision
+    # service's surface (sparksched_tpu/serve/session.py:
+    # store_from_config), validated with the same fail-loud contract
+    "capacity",  # session-store slots (one live cluster per tenant)
+    "max_batch",  # micro-batch width K (the batched AOT program's shape)
+    "linger_ms",  # bounded linger window of the micro-batching front
+    "deterministic",  # greedy serving (default True)
+    "donate",  # donate the store buffer to the serve programs
+    "seed",  # base key for session resets / sampling
+})
+
 CHAOS_KEYS = frozenset({
     "seed",  # injection-index derivation seed
     "nan_grad",  # iterations: poison one recorded reward with NaN

@@ -1023,6 +1023,61 @@ def drain_to_decision(
     return ls, (rw, dt, rs)
 
 
+def apply_and_drain(
+    params: EnvParams,
+    bank: WorkloadBank,
+    ls: LoopState,
+    stage_idx: jnp.ndarray,
+    num_exec: jnp.ndarray,
+    rng: jax.Array,
+    auto_reset: bool = False,
+    event_bulk: bool = True,
+    bulk_events: int = 8,
+    fulfill_bulk: bool = True,
+    bulk_cycles: int = 1,
+    bulk_fused: bool = True,
+    telemetry=None,
+) -> tuple:
+    """One PRECOMPUTED decision applied and drained to the next decision
+    point, for ONE lane: `decide_micro_step` (commit or round-finish)
+    followed by `drain_to_decision` (FULFILL leftovers + the whole
+    inter-decision event run) — the serving-shaped unit of work the
+    AOT decision service compiles (`sparksched_tpu/serve/`). It drives
+    the same two primitives as the single-eval collectors' scan body
+    (`trainers/rollout.py:_flat_collect_single_eval`), but is NOT that
+    body: the collectors carry their discount reference across rows
+    (an undecided lane keeps the previous decision's `t_ref`), while
+    a served request always references the lane's wall time at entry —
+    per-request accounting, there is no previous row to carry. The
+    engine-level decision semantics shared with training are pinned by
+    the decide/drain step-exactness tests, not by this wrapper.
+    Returns `(ls, (decided, reward, dt, reset)[, telemetry])` —
+    `reward`/`dt` accumulate over the decide step and the whole
+    drain."""
+    track = telemetry is not None
+    k_dec, k_drain = jax.random.split(rng)
+    t_ref = ls.env.wall_time
+    out = decide_micro_step(
+        params, bank, ls, stage_idx, num_exec, k_dec, auto_reset,
+        fulfill_bulk, t_ref=t_ref, telemetry=telemetry,
+    )
+    if track:
+        ls2, (decided, rw1, dt1, rs1), telemetry = out
+    else:
+        ls2, (decided, rw1, dt1, rs1) = out
+    out = drain_to_decision(
+        params, bank, ls2, k_drain, auto_reset, event_bulk,
+        bulk_events, bulk_cycles, t_ref=t_ref, telemetry=telemetry,
+        bulk_fused=bulk_fused,
+    )
+    if track:
+        ls3, (rw2, dt2, rs2), telemetry = out
+    else:
+        ls3, (rw2, dt2, rs2) = out
+    rec = (decided, rw1 + rw2, dt1 + dt2, rs1 | rs2)
+    return (ls3, rec, telemetry) if track else (ls3, rec)
+
+
 def run_flat(
     params: EnvParams,
     bank: WorkloadBank,

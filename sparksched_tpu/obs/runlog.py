@@ -14,6 +14,12 @@ kind) and `t` (unix seconds); the kinds the trainer/bench write:
 - `memory`: a device-memory sample (`obs.memory.device_memory_stats`
   fields — `bytes_in_use` / `peak_bytes_in_use` — plus the optional
   `iteration`/`phase` the sample brackets)
+- `latency`: a decision-latency sample from the serving path
+  (ISSUE 10) — the measured percentile block (`p50_ms` / `p90_ms` /
+  `p99_ms` / `mean_ms`), the `batch` width and `reps` behind it, and
+  cold-start fields; `sparksched_tpu/serve/` sessions additionally
+  write per-iteration `serve_*` scalars through the standard
+  `scalars` record (TensorBoard-mirrored like the trainer's)
 - `health`: a tripped in-JIT health sentinel (ISSUE 9) — the raw i32
   violation bitmask (`mask`), its decoded `bits` (env/health.py bit
   table), the `iteration`/`attempt` it quarantines, and the recovery
@@ -174,6 +180,20 @@ class RunLog:
         self.write(
             "health", mask=int(mask), bits=describe_mask(mask), **fields
         )
+
+    def latency(self, stats: dict[str, Any],
+                iteration: int | None = None, phase: str | None = None,
+                **fields: Any) -> None:
+        """A decision-latency sample (ISSUE 10 serving path): the
+        percentile block the latency bench measures (`p50_ms` /
+        `p90_ms` / `p99_ms` / `mean_ms`, plus `batch`, `reps`,
+        cold-start fields). Keys land top-level so runlogs stay
+        greppable (`grep '"ev": "latency"'`), like `memory` records."""
+        if iteration is not None:
+            fields["iteration"] = int(iteration)
+        if phase is not None:
+            fields["phase"] = phase
+        self.write("latency", **(dict(stats or {}) | fields))
 
     def memory(self, stats: dict[str, Any],
                iteration: int | None = None, phase: str | None = None,

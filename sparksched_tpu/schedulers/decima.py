@@ -661,6 +661,22 @@ class DecimaScheduler(TrainableScheduler):
 
         return policy_fn
 
+    def serve_policies(self, params=None, deterministic: bool = True):
+        """The `(policy_fn, batch_policy_fn)` pair the AOT decision
+        service compiles (`sparksched_tpu/serve/`): the unbatched
+        single-session program closes over `policy_fn`, the width-K
+        micro-batch program over `batch_policy_fn` — the SAME bound
+        parameters, so the two serve paths cannot disagree on weights.
+        Serving defaults to greedy (`deterministic=True`): a production
+        decision is the argmax of both heads, rng-independent, so equal
+        session states always serve equal decisions regardless of the
+        request's batch placement."""
+        p = self.params if params is None else params
+        return (
+            self.flat_policy(p, deterministic),
+            self.flat_batch_policy(p, deterministic),
+        )
+
     # -- host-side single decision ----------------------------------------
     def schedule(self, obs: Observation):
         self._rng, sub = jax.random.split(self._rng)

@@ -1,0 +1,41 @@
+"""AOT-compiled low-latency decision serving (ROADMAP item 3).
+
+The latency side of the engine: persistent per-tenant cluster sessions
+served through ahead-of-time-compiled, buffer-donated decision
+programs, with a bounded-linger micro-batching front riding the
+width-K `batch_policy` compaction. See `serve/aot.py` (the compiled
+programs), `serve/session.py` (the session API), and the README
+"Serving" section for the warmup protocol and knobs.
+"""
+
+from .aot import (
+    ServeOut,
+    aot_compile,
+    serve_callables,
+    serve_decide_batch_fn,
+    serve_decide_fn,
+)
+from .session import (
+    MicroBatcher,
+    ServeResult,
+    SessionError,
+    SessionQuarantined,
+    SessionStore,
+    Ticket,
+    store_from_config,
+)
+
+__all__ = [
+    "ServeOut",
+    "aot_compile",
+    "serve_callables",
+    "serve_decide_batch_fn",
+    "serve_decide_fn",
+    "MicroBatcher",
+    "ServeResult",
+    "SessionError",
+    "SessionQuarantined",
+    "SessionStore",
+    "Ticket",
+    "store_from_config",
+]

@@ -1,0 +1,333 @@
+"""AOT-compiled serve programs: the latency side of the engine.
+
+Everything else in the repo is throughput-shaped (big-batch rollout
+collection); the deployment story of the source paper — Decima
+scheduling a live Spark cluster — is a request/response loop: one
+cluster state arrives, one decision leaves, microseconds of budget.
+This module builds that decision as an ahead-of-time-compiled XLA
+executable over a persistent on-device session *store*:
+
+- ``serve_decide``: ONE session's decision. The store (a [C]-stacked
+  `LoopState`, one live cluster per tenant) is gathered at a dynamic
+  slot index, the policy runs unbatched (observe -> Decima score ->
+  masked sample/argmax), the decision is applied and drained to the
+  next decision point (`env/flat_loop.py:apply_and_drain` — the same
+  per-lane body the single-eval training collectors run, so serving
+  and training cannot drift on decision semantics), and the updated
+  lane is scattered back. An optional forced action (`step` in the
+  session API) overrides the policy's pick under a traced select, so
+  policy-decide and caller-step share one compiled program.
+- ``serve_decide_batch``: up to K sessions in ONE call — gather K
+  slots, ONE batched policy evaluation (`DecimaScheduler.batch_policy`
+  with the width-K active-job compaction at batch level), vmapped
+  apply-and-drain, scatter back. Padding slots carry index C (out of
+  range): their gathers clamp, their scatters `mode="drop"`, and their
+  outputs are masked by `valid`, so a partial batch mutates exactly
+  the sessions it names.
+
+Both programs DONATE the store argument (`donate_argnums=(0,)`): XLA
+aliases the output store onto the input buffers, so a steady-state
+decision allocates nothing store-sized — the [C] cluster states are
+updated in place (`tests/test_serve.py` pins the aliasing: the donated
+input is deleted and the output leaf reuses its buffer). Compilation is
+`jax.jit(...).lower(...).compile()` at session-store construction:
+after the warmup call there is no tracing, no dispatch-cache lookup
+miss, and no recompile on the serve path (pinned via the runlog
+recompile events).
+
+The per-decision health sentinel (`env/health.py:state_health` over
+the post-drain state + the span reward, ISSUE 9) rides every output:
+the session layer quarantines a session whose mask is non-zero instead
+of serving it again.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import EnvParams
+from ..env.flat_loop import (
+    LoopState,
+    _lane_done,
+    apply_and_drain,
+    aux_action_fields,
+)
+from ..env.health import reward_health, state_health
+from ..env.observe import observe
+from ..obs.tracing import annotate
+from ..workload.bank import WorkloadBank
+
+_i32 = jnp.int32
+
+
+class ServeOut(struct.PyTreeNode):
+    """One served decision (leading [K] axis on the batch program).
+
+    `valid` marks real (non-padding) batch slots; `decided` whether the
+    lane actually recorded a decision (False for a lane whose episode
+    was already over — `done` — which the session layer reports instead
+    of serving). `health_mask` is the i32 sentinel bitmask
+    (env/health.py bit table) over the post-drain state and the span
+    reward; non-zero quarantines the session host-side."""
+
+    stage_idx: jnp.ndarray  # i32; flat padded node index (-1 = none)
+    job_idx: jnp.ndarray  # i32; padded job id
+    num_exec: jnp.ndarray  # i32; 1-based executor count (env convention)
+    lgprob: jnp.ndarray  # f32; log-prob of the chosen action
+    decided: jnp.ndarray  # bool; lane recorded a decision
+    done: jnp.ndarray  # bool; episode over after the drain
+    reward: jnp.ndarray  # f32; span reward (decision -> next decision)
+    dt: jnp.ndarray  # f32; sim-time advance of the span
+    wall_time: jnp.ndarray  # f32; lane wall clock after the drain
+    health_mask: jnp.ndarray  # i32; sentinel bitmask (0 = healthy)
+    valid: jnp.ndarray  # bool; real (non-padding) slot
+
+
+# engine knobs of the serve drain — the round-5 on-chip calibration
+# (be=8, fulfill_bulk on, one fused cycle), the same defaults the
+# single-eval collectors ship
+SERVE_KNOBS: dict[str, Any] = {
+    "event_bulk": True,
+    "bulk_events": 8,
+    "fulfill_bulk": True,
+    "bulk_cycles": 1,
+    "bulk_fused": True,
+}
+
+
+def _decide_one(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: Callable,
+    ls: LoopState,
+    key: jax.Array,
+    force_stage: jnp.ndarray,
+    force_nexec: jnp.ndarray,
+    use_force: jnp.ndarray,
+    knobs: dict[str, Any],
+) -> tuple[LoopState, ServeOut]:
+    """One lane's full decision: observe -> policy (or the forced
+    action under `use_force`) -> apply_and_drain -> health sentinel."""
+    k_pol, k_env = jax.random.split(key)
+    env0 = ls.env
+    was_done = _lane_done(env0)
+    obs = observe(params, env0)
+    stage_idx, num_exec, aux = policy_fn(k_pol, obs)
+    lgprob, job, _ = aux_action_fields(
+        aux, stage_idx, num_exec, params.max_stages
+    )
+    stage_idx = jnp.where(use_force, force_stage, stage_idx).astype(_i32)
+    num_exec = jnp.where(use_force, force_nexec, num_exec).astype(_i32)
+    job = jnp.where(
+        use_force,
+        jnp.where(stage_idx >= 0, stage_idx // params.max_stages, 0),
+        job,
+    ).astype(_i32)
+    lgprob = jnp.where(use_force, 0.0, lgprob).astype(jnp.float32)
+    ls2, (decided, reward, dt, reset) = apply_and_drain(
+        params, bank, ls, stage_idx, num_exec, k_env,
+        auto_reset=False, **knobs,
+    )
+    hm = state_health(ls2.env, prev=env0, resetting=reset) | reward_health(
+        reward
+    )
+    # a lane that was already done is frozen by the engine: report it
+    # rather than claim a decision happened
+    out = ServeOut(
+        stage_idx=jnp.where(decided, stage_idx, -1).astype(_i32),
+        job_idx=job,
+        num_exec=num_exec,
+        lgprob=lgprob,
+        decided=decided,
+        done=_lane_done(ls2.env),
+        reward=reward,
+        dt=dt,
+        wall_time=ls2.env.wall_time,
+        health_mask=jnp.where(was_done, 0, hm).astype(_i32),
+        valid=jnp.bool_(True),
+    )
+    return ls2, out
+
+
+def serve_decide_fn(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: Callable,
+    knobs: dict[str, Any] | None = None,
+) -> Callable:
+    """The single-session store program:
+    `(store [C], slot, key, force_stage, force_nexec, use_force) ->
+    (store [C], ServeOut)`. Gather one lane, decide unbatched, scatter
+    back; the store argument is meant to be donated at compile time."""
+    kn = SERVE_KNOBS | (knobs or {})
+
+    def fn(store: LoopState, slot, key, force_stage, force_nexec,
+           use_force):
+        with annotate("serve/decide"):
+            ls = jax.tree_util.tree_map(lambda a: a[slot], store)
+            ls2, out = _decide_one(
+                params, bank, policy_fn, ls, key,
+                force_stage, force_nexec, use_force, kn,
+            )
+            store2 = jax.tree_util.tree_map(
+                lambda s, v: s.at[slot].set(v), store, ls2
+            )
+        return store2, out
+
+    return fn
+
+
+def serve_decide_batch_fn(
+    params: EnvParams,
+    bank: WorkloadBank,
+    batch_policy_fn: Callable,
+    batch: int,
+    knobs: dict[str, Any] | None = None,
+) -> Callable:
+    """The micro-batched store program:
+    `(store [C], slots [K], key) -> (store [C], ServeOut-of-[K])`.
+    ONE batched policy evaluation over the K gathered sessions (the
+    width-K `batch_policy` compaction is exactly a serving-batch
+    primitive), vmapped apply-and-drain, scatter back. Padding slots
+    carry index C: gathers clamp to a real lane whose results are then
+    dropped by the `mode="drop"` scatter and masked in the output."""
+    kn = SERVE_KNOBS | (knobs or {})
+    K = int(batch)
+
+    def fn(store: LoopState, slots, key):
+        with annotate("serve/decide_batch"):
+            C = store.mode.shape[0]
+            valid = slots < C
+            idx = jnp.minimum(slots, C - 1)
+            ls = jax.tree_util.tree_map(lambda a: a[idx], store)
+            env0 = ls.env
+            was_done = jax.vmap(_lane_done)(env0)
+            k_pol, k_env = jax.random.split(key)
+            obs = jax.vmap(lambda e: observe(params, e))(env0)
+            stage_idx, num_exec, aux = batch_policy_fn(k_pol, obs)
+            lgprob, job, _ = aux_action_fields(
+                aux, stage_idx, num_exec, params.max_stages
+            )
+            lgprob = jnp.broadcast_to(
+                jnp.asarray(lgprob, jnp.float32), stage_idx.shape
+            )
+            ls2, (decided, reward, dt, reset) = jax.vmap(
+                lambda l, si, ne, k: apply_and_drain(
+                    params, bank, l, si, ne, k, auto_reset=False, **kn
+                )
+            )(ls, stage_idx, num_exec, jax.random.split(k_env, K))
+            hm = jax.vmap(state_health)(
+                ls2.env, env0, reset
+            ) | reward_health(reward)
+            out = ServeOut(
+                stage_idx=jnp.where(
+                    decided & valid, stage_idx, -1
+                ).astype(_i32),
+                job_idx=job.astype(_i32),
+                num_exec=num_exec.astype(_i32),
+                lgprob=lgprob,
+                decided=decided & valid,
+                done=jax.vmap(_lane_done)(ls2.env),
+                reward=reward,
+                dt=dt,
+                wall_time=ls2.env.wall_time,
+                health_mask=jnp.where(
+                    was_done | ~valid, 0, hm
+                ).astype(_i32),
+                valid=valid,
+            )
+            # padding slots (index C) drop instead of scattering the
+            # clamped lane's speculative update back over a real session
+            store2 = jax.tree_util.tree_map(
+                lambda s, v: s.at[slots].set(v, mode="drop"), store, ls2
+            )
+        return store2, out
+
+    return fn
+
+
+def aot_compile(fn: Callable, *abstract_args, donate_store: bool = True):
+    """`jax.jit(fn).lower(...).compile()` with the store (arg 0)
+    donated. Returns `(compiled, secs)` — the compile wall time is the
+    cold-start figure the latency bench records. The compiled
+    executable bypasses the jit dispatch cache entirely: no tracing,
+    no cache lookup, no recompile can happen on the warm path."""
+    t0 = time.perf_counter()
+    jitted = jax.jit(
+        fn, donate_argnums=(0,) if donate_store else ()
+    )
+    compiled = jitted.lower(*abstract_args).compile()
+    return compiled, time.perf_counter() - t0
+
+
+def abstract_like(tree):
+    """ShapeDtypeStructs of a concrete pytree — the `.lower()` argument
+    spec (lowering never needs the store's values, only its shapes)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(
+            jnp.shape(a), jnp.result_type(a)
+        ),
+        tree,
+    )
+
+
+# ---------------------------------------------------------------------------
+# analysis-registry builders (sparksched_tpu/analysis): the serve
+# programs as (callable, abstract args) at audit shapes, so their eqn
+# and temp-byte budgets are CI-pinned like the other registered
+# programs. Audit store capacity / batch width are small (shapes only
+# scale buffer sizes, not equation counts) but both Decima score
+# branches (compact + full-width fallback) are in the audited program
+# via the scaled job_bucket, matching the decima_* registry entries.
+# ---------------------------------------------------------------------------
+
+SERVE_AUDIT_CAPACITY = 8
+SERVE_AUDIT_BATCH = 4
+
+
+def serve_callables(
+    capacity: int = SERVE_AUDIT_CAPACITY,
+    batch: int = SERVE_AUDIT_BATCH,
+) -> dict[str, tuple[Callable, tuple]]:
+    """`serve_decide` / `serve_decide_batch` under the shared audit
+    config (analysis/jaxpr_audit.py:audit_setup), as
+    (callable, abstract args)."""
+    from ..analysis.jaxpr_audit import (
+        _shipped_agent_kwargs,
+        audit_setup,
+    )
+    from ..env.flat_loop import init_loop_state
+    from ..schedulers.decima import DecimaScheduler
+
+    params, bank, state = audit_setup()
+    sched = DecimaScheduler(
+        num_executors=params.num_executors, job_bucket=8,
+        **_shipped_agent_kwargs(),
+    )
+    pol, bpol = sched.serve_policies(deterministic=True)
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    ls1 = jax.eval_shape(init_loop_state, state)
+    store = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(
+            (capacity,) + tuple(l.shape), l.dtype
+        ),
+        ls1,
+    )
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    b = jax.ShapeDtypeStruct((), jnp.bool_)
+    slots = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    return {
+        "serve_decide": (
+            serve_decide_fn(params, bank, pol),
+            (store, i32, key, i32, i32, b),
+        ),
+        "serve_decide_batch": (
+            serve_decide_batch_fn(params, bank, bpol, batch),
+            (store, slots, key),
+        ),
+    }

@@ -1,0 +1,465 @@
+"""Persistent decision-serving sessions over the AOT programs.
+
+A `SessionStore` holds one live on-device cluster (`LoopState`) per
+tenant in a fixed-capacity [C]-stacked store, and serves decisions
+through the two ahead-of-time-compiled programs built at construction
+(`serve/aot.py`): the unbatched single-session path and the width-K
+micro-batched path. The store buffer is DONATED to every serve call,
+so steady-state decisions update the [C] cluster states in place —
+zero store-sized allocation, zero tracing, zero recompiles after the
+constructor's warmup call.
+
+Session lifecycle (`create` / `step` / `decide` / `close`):
+
+- `create(seed)` resets a fresh episode into a free slot and returns
+  its session id. Slot writes go through a small compiled updater, not
+  the serve programs.
+- `decide(sid)` serves one policy decision for the session and drains
+  its cluster to the next decision point (the serving unit of work);
+  `step(sid, stage_idx, num_exec)` applies a CALLER-chosen action
+  through the same compiled program (the forced-action select), for
+  tenants that want the simulator without the policy.
+- every served decision carries the in-JIT health sentinel mask
+  (env/health.py, ISSUE 9): a non-zero mask QUARANTINES the session —
+  it is never served again (decide/step raise `SessionQuarantined`),
+  but its slot is only reclaimed by an explicit `close`. A poisoned
+  cluster state must not keep emitting decisions.
+- `close(sid)` frees the slot.
+
+`MicroBatcher` is the batching front: requests accumulate until either
+`max_batch` sessions are pending or the oldest request has waited
+`linger_ms` (the bounded linger window), then flush as ONE compiled
+width-K call; a flush of a single pending request falls back to the
+unbatched AOT path (no padded batch work for a lone request). It is
+deliberately synchronous — `submit` returns a `Ticket`, and `poll()`
+(or a full batch) flushes — so a network front can drive it from any
+event loop and the latency bench can measure it deterministically.
+
+Config surface: the top-level `serve:` YAML block
+(`config.SERVE_KEYS`), validated loudly like the `health:`/`chaos:`
+blocks — a typo'd knob must fail, not silently serve with defaults.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import SERVE_KEYS, EnvParams
+from ..env import core
+from ..env.flat_loop import init_loop_state
+from ..workload.bank import WorkloadBank
+from .aot import (
+    SERVE_KNOBS,
+    abstract_like,
+    aot_compile,
+    serve_decide_batch_fn,
+    serve_decide_fn,
+)
+
+_i32 = jnp.int32
+
+
+class SessionError(KeyError):
+    """Unknown / closed session id."""
+
+
+class SessionQuarantined(RuntimeError):
+    """The session's health sentinel tripped; it will not be served."""
+
+
+class ServeResult:
+    """Host-side view of one served decision (plain numpy scalars)."""
+
+    __slots__ = (
+        "session_id", "stage_idx", "job_idx", "num_exec", "lgprob",
+        "decided", "done", "reward", "dt", "wall_time", "health_mask",
+        "batched",
+    )
+
+    def __init__(self, session_id: int, out, i: int | None,
+                 batched: bool) -> None:
+        pick = (lambda a: a[i]) if i is not None else (lambda a: a)
+        self.session_id = session_id
+        self.stage_idx = int(pick(out.stage_idx))
+        self.job_idx = int(pick(out.job_idx))
+        self.num_exec = int(pick(out.num_exec))
+        self.lgprob = float(pick(out.lgprob))
+        self.decided = bool(pick(out.decided))
+        self.done = bool(pick(out.done))
+        self.reward = float(pick(out.reward))
+        self.dt = float(pick(out.dt))
+        self.wall_time = float(pick(out.wall_time))
+        self.health_mask = int(pick(out.health_mask))
+        self.batched = batched
+
+    def to_dict(self) -> dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class SessionStore:
+    """Fixed-capacity persistent session store over donated AOT
+    programs. Not thread-safe by design: a serving front owns one
+    store per worker (the donation discipline — exactly one live
+    reference to the store buffer — does not compose with concurrent
+    mutation)."""
+
+    def __init__(
+        self,
+        params: EnvParams,
+        bank: WorkloadBank,
+        scheduler,
+        capacity: int = 64,
+        *,
+        max_batch: int = 8,
+        deterministic: bool = True,
+        donate: bool = True,
+        seed: int = 0,
+        knobs: dict[str, Any] | None = None,
+        runlog=None,
+        tb_writer=None,
+    ) -> None:
+        if not 1 <= max_batch <= capacity:
+            raise ValueError(
+                f"max_batch={max_batch} must be in [1, capacity="
+                f"{capacity}]"
+            )
+        self.params = params
+        self.bank = bank
+        self.capacity = int(capacity)
+        self.max_batch = int(max_batch)
+        self.donate = bool(donate)
+        self.knobs = SERVE_KNOBS | (knobs or {})
+        self._runlog = runlog
+        self._tb = tb_writer
+        self._base_key = jax.random.PRNGKey(seed)
+        self._calls = 0
+
+        pol, bpol = scheduler.serve_policies(
+            deterministic=deterministic
+        )
+        self._reset1 = jax.jit(
+            lambda k: init_loop_state(core.reset(params, bank, k))
+        )
+        self._write_slot = jax.jit(
+            lambda store, sid, ls: jax.tree_util.tree_map(
+                lambda s, v: s.at[sid].set(v), store, ls
+            ),
+            donate_argnums=(0,) if donate else (),
+        )
+
+        # the [C] store starts as C copies of one dummy reset episode;
+        # create() overwrites a slot with its own seeded reset
+        ls0 = self._reset1(jax.random.fold_in(self._base_key, 2**19))
+        store = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(
+                a, (self.capacity,) + a.shape
+            ).copy(),
+            ls0,
+        )
+
+        # ---- AOT lowering + compile (the cold start) ----
+        fn1 = serve_decide_fn(params, bank, pol, self.knobs)
+        fnk = serve_decide_batch_fn(
+            params, bank, bpol, self.max_batch, self.knobs
+        )
+        st_abs = abstract_like(store)
+        key = abstract_like(self._base_key)
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        b = jax.ShapeDtypeStruct((), jnp.bool_)
+        slots = jax.ShapeDtypeStruct((self.max_batch,), jnp.int32)
+        self._c1, secs1 = aot_compile(
+            fn1, st_abs, i32, key, i32, i32, b, donate_store=donate
+        )
+        self._ck, secsk = aot_compile(
+            fnk, st_abs, slots, key, donate_store=donate
+        )
+        self.compile_secs = {"decide": secs1, "decide_batch": secsk}
+
+        # host-side slot bookkeeping
+        self._live = np.zeros(self.capacity, bool)
+        self._quarantined = np.zeros(self.capacity, bool)
+        self.stats = {
+            "serve_decisions": 0,
+            "serve_batched_decisions": 0,
+            "serve_batch_calls": 0,
+            "serve_quarantines": 0,
+            "serve_sessions_live": 0,
+        }
+
+        # ---- warmup: one call per program, so the warm path never
+        # pays a first-dispatch (executable load, buffer layout) cost.
+        # Slot contents are dummies here; create() re-seeds slots.
+        self._store = store
+        t0 = time.perf_counter()
+        self._store, _ = self._call1(
+            _i32(0), _i32(-1), _i32(0), jnp.bool_(False)
+        )
+        self._store, _ = self._callk(
+            jnp.full((self.max_batch,), self.capacity, _i32)
+        )
+        jax.block_until_ready(self._store.mode)
+        self.warmup_secs = time.perf_counter() - t0
+        # reset warmup's mutation of slot 0 back to a clean dummy
+        self._store = self._write_slot(self._store, _i32(0), ls0)
+
+    # -- compiled-call plumbing -------------------------------------------
+
+    def _next_key(self) -> jax.Array:
+        self._calls += 1
+        return jax.random.fold_in(self._base_key, self._calls)
+
+    def _call1(self, sid, fstage, fnexec, use_force):
+        return self._c1(
+            self._store, sid, self._next_key(), fstage, fnexec,
+            use_force,
+        )
+
+    def _callk(self, slots):
+        return self._ck(self._store, slots, self._next_key())
+
+    # -- session lifecycle -------------------------------------------------
+
+    def create(self, seed: int | None = None) -> int:
+        """Reset a fresh episode into a free slot; returns the session
+        id. Raises `RuntimeError` when the store is full."""
+        free = np.flatnonzero(~self._live & ~self._quarantined)
+        if free.size == 0:
+            raise RuntimeError(
+                f"session store full ({self.capacity} slots live or "
+                "quarantined); close sessions first"
+            )
+        sid = int(free[0])
+        k = (
+            jax.random.fold_in(self._base_key, 2**20 + sid)
+            if seed is None
+            else jax.random.PRNGKey(seed)
+        )
+        self._store = self._write_slot(
+            self._store, _i32(sid), self._reset1(k)
+        )
+        self._live[sid] = True
+        self.stats["serve_sessions_live"] = int(self._live.sum())
+        return sid
+
+    def close(self, sid: int) -> None:
+        self._check_sid(sid, allow_quarantined=True)
+        self._live[sid] = False
+        self._quarantined[sid] = False
+        self.stats["serve_sessions_live"] = int(self._live.sum())
+
+    def _check_sid(self, sid: int, allow_quarantined: bool = False
+                   ) -> None:
+        if not 0 <= sid < self.capacity or not self._live[sid]:
+            raise SessionError(f"unknown session id {sid}")
+        if self._quarantined[sid] and not allow_quarantined:
+            raise SessionQuarantined(
+                f"session {sid} is quarantined (health sentinel "
+                "tripped); close it and create a fresh one"
+            )
+
+    def _apply_health(self, sid: int, mask: int) -> None:
+        if mask == 0:
+            return
+        self._quarantined[sid] = True
+        self.stats["serve_quarantines"] += 1
+        if self._runlog is not None:
+            self._runlog.health(
+                mask, session_id=sid, action="quarantine",
+                origin="serve",
+            )
+
+    # -- serving -----------------------------------------------------------
+
+    def decide(self, sid: int) -> ServeResult:
+        """One policy decision on the unbatched AOT path."""
+        self._check_sid(sid)
+        self._store, out = self._call1(
+            _i32(sid), _i32(-1), _i32(0), jnp.bool_(False)
+        )
+        res = ServeResult(sid, jax.device_get(out), None, batched=False)
+        self._apply_health(sid, res.health_mask)
+        self.stats["serve_decisions"] += 1
+        return res
+
+    def step(self, sid: int, stage_idx: int, num_exec: int
+             ) -> ServeResult:
+        """Apply a CALLER-chosen action (same compiled program; the
+        policy's pick is overridden by the forced-action select)."""
+        self._check_sid(sid)
+        self._store, out = self._call1(
+            _i32(sid), _i32(stage_idx), _i32(num_exec),
+            jnp.bool_(True),
+        )
+        res = ServeResult(sid, jax.device_get(out), None, batched=False)
+        self._apply_health(sid, res.health_mask)
+        self.stats["serve_decisions"] += 1
+        return res
+
+    def decide_batch(self, sids: list[int]) -> list[ServeResult]:
+        """Up to `max_batch` sessions in ONE compiled call. A single
+        session falls back to the unbatched path (no padded batch work
+        for a lone request)."""
+        if not sids:
+            return []
+        if len(sids) > self.max_batch:
+            raise ValueError(
+                f"{len(sids)} sessions > max_batch={self.max_batch}"
+            )
+        for sid in sids:
+            self._check_sid(sid)
+        if len(set(sids)) != len(sids):
+            raise ValueError("duplicate session ids in one batch")
+        if len(sids) == 1:
+            return [self.decide(sids[0])]
+        slots = np.full(self.max_batch, self.capacity, np.int32)
+        slots[: len(sids)] = sids
+        self._store, out = self._callk(jnp.asarray(slots))
+        out = jax.device_get(out)
+        results = []
+        for i, sid in enumerate(sids):
+            res = ServeResult(sid, out, i, batched=True)
+            self._apply_health(sid, res.health_mask)
+            results.append(res)
+        self.stats["serve_decisions"] += len(sids)
+        self.stats["serve_batched_decisions"] += len(sids)
+        self.stats["serve_batch_calls"] += 1
+        return results
+
+    # -- observability -----------------------------------------------------
+
+    def log_stats(self, iteration: int, extra: dict[str, Any] | None
+                  = None) -> None:
+        """Per-iteration `serve_*` scalars: runlog JSONL + the
+        TensorBoard mirror when a writer was given — the serving analog
+        of the trainer's `_write_stats` (identical keys/values both
+        sinks)."""
+        stats = dict(self.stats) | (extra or {})
+        if self._runlog is not None:
+            self._runlog.scalars(iteration, stats)
+        if self._tb is not None:
+            for k, v in stats.items():
+                self._tb.add_scalar(k, v, iteration)
+
+
+class Ticket:
+    """One pending micro-batch request. At flush either `result` is
+    set, or `error` holds the per-request failure (a quarantined or
+    closed session fails ITS ticket only — co-batched requests are
+    still served)."""
+
+    __slots__ = ("session_id", "submitted_at", "result", "error")
+
+    def __init__(self, session_id: int) -> None:
+        self.session_id = session_id
+        self.submitted_at = time.perf_counter()
+        self.result: ServeResult | None = None
+        self.error: Exception | None = None
+
+    @property
+    def ready(self) -> bool:
+        return self.result is not None or self.error is not None
+
+
+class MicroBatcher:
+    """Bounded-linger micro-batching front over a `SessionStore`.
+
+    `submit(sid)` enqueues and flushes immediately when `max_batch`
+    requests are pending; `poll()` flushes when the OLDEST pending
+    request has waited `linger_ms` (the bounded linger window — the
+    worst case a request can be delayed in exchange for batching);
+    `flush()` forces. A lone pending request always takes the
+    unbatched AOT path (SessionStore.decide_batch's fallback)."""
+
+    def __init__(self, store: SessionStore, linger_ms: float = 1.0
+                 ) -> None:
+        self.store = store
+        self.linger_s = float(linger_ms) / 1e3
+        self._pending: list[Ticket] = []
+
+    def submit(self, sid: int) -> Ticket:
+        t = Ticket(sid)
+        self._pending.append(t)
+        if len(self._pending) >= self.store.max_batch:
+            self.flush()
+        return t
+
+    def poll(self) -> bool:
+        """Flush if the linger window expired; True when a flush ran."""
+        if not self._pending:
+            return False
+        waited = time.perf_counter() - self._pending[0].submitted_at
+        if waited >= self.linger_s:
+            self.flush()
+            return True
+        return False
+
+    def flush(self) -> None:
+        """Serve every pending ticket. Duplicate session ids in one
+        window ride SUCCESSIVE batch calls (one session id per batch —
+        decide_batch rejects duplicates, and two decisions for one
+        session are sequential by definition). A request that cannot
+        be served (quarantined / closed session) fails its OWN ticket
+        via `Ticket.error`; the rest of the batch is still served —
+        no ticket is ever left unresolved."""
+        while self._pending:
+            batch: list[Ticket] = []
+            seen: set[int] = set()
+            rest: list[Ticket] = []
+            for t in self._pending:
+                if (len(batch) < self.store.max_batch
+                        and t.session_id not in seen):
+                    batch.append(t)
+                    seen.add(t.session_id)
+                else:
+                    rest.append(t)
+            self._pending = rest  # each pass consumes >= 1 ticket
+            try:
+                results = self.store.decide_batch(
+                    [t.session_id for t in batch]
+                )
+            except Exception:
+                # a bad session id poisons the whole batch call;
+                # re-serve one by one so only the offender fails
+                for t in batch:
+                    try:
+                        t.result = self.store.decide(t.session_id)
+                    except Exception as e:
+                        t.error = e
+                continue
+            for t, r in zip(batch, results):
+                t.result = r
+
+
+def store_from_config(
+    cfg: dict[str, Any] | None,
+    params: EnvParams,
+    bank: WorkloadBank,
+    scheduler,
+    **overrides: Any,
+) -> SessionStore:
+    """Build a `SessionStore` from a top-level `serve:` YAML block.
+    Unknown keys fail loudly (the `health:`/`chaos:` block contract —
+    config.SERVE_KEYS is the single source of truth for the surface).
+    Returns the store; `linger_ms` is consumed by the caller building
+    a `MicroBatcher` (it is a front knob, not a store knob)."""
+    cfg = dict(cfg or {})
+    unknown = set(cfg) - set(SERVE_KEYS)
+    if unknown:
+        raise ValueError(
+            f"unknown serve: config key(s) {sorted(unknown)}; known "
+            f"keys: {sorted(SERVE_KEYS)}"
+        )
+    kw: dict[str, Any] = {
+        "capacity": int(cfg.get("capacity", 64)),
+        "max_batch": int(cfg.get("max_batch", 8)),
+        "deterministic": bool(cfg.get("deterministic", True)),
+        "donate": bool(cfg.get("donate", True)),
+        "seed": int(cfg.get("seed", 0)),
+    }
+    kw.update(overrides)
+    return SessionStore(params, bank, scheduler, **kw)

@@ -249,6 +249,51 @@ def test_runlog_memory_record_schema(tmp_path):
     assert mems[1]["phase"] == "bench_warmup"
 
 
+def test_runlog_latency_record_and_serve_scalars(tmp_path):
+    """ISSUE 10: the `latency` record kind (serving-path percentile
+    samples, keys top-level and greppable like `memory`) and the
+    serve-session `serve_*` per-iteration scalars — written through
+    the standard `scalars` record and mirrored verbatim to a
+    TensorBoard-style writer, the trainer's `_write_stats` contract."""
+    from sparksched_tpu.obs import RunLog
+
+    rl = RunLog(str(tmp_path / "l.jsonl"))
+    rl.latency(
+        {"p50_ms": 1.5, "p90_ms": 2.0, "p99_ms": 9.9, "mean_ms": 1.8,
+         "reps": 100},
+        iteration=2, batch=8,
+    )
+    rl.latency(None, phase="cold_start", cold_start_s=12.5)
+
+    class _TB:
+        def __init__(self):
+            self.seen = []
+
+        def add_scalar(self, k, v, i):
+            self.seen.append((k, v, i))
+
+    tb = _TB()
+
+    class _Store:  # the SessionStore.log_stats surface, storeless
+        stats = {"serve_decisions": 7, "serve_quarantines": 1}
+        _runlog, _tb = rl, tb
+        from sparksched_tpu.serve.session import SessionStore as _S
+        log_stats = _S.log_stats
+
+    _Store().log_stats(5, extra={"serve_p50_ms": 1.5})
+    rl.close()
+    recs = [json.loads(ln) for ln in open(rl.path)]
+    lats = [r for r in recs if r["ev"] == "latency"]
+    assert lats[0]["p50_ms"] == 1.5 and lats[0]["p99_ms"] == 9.9
+    assert lats[0]["iteration"] == 2 and lats[0]["batch"] == 8
+    assert lats[1]["phase"] == "cold_start"
+    sc = [r for r in recs if r["ev"] == "scalars"][0]
+    assert sc["serve_decisions"] == 7 and sc["iteration"] == 5
+    # the TB mirror received identical keys/values at the iteration
+    assert ("serve_decisions", 7, 5) in tb.seen
+    assert ("serve_p50_ms", 1.5, 5) in tb.seen
+
+
 # ---------------------------------------------------------------------------
 # crash-safety (satellite): a watcher-killed run must leave a parseable
 # runlog with its partial telemetry — SIGTERM lands a final run_end via

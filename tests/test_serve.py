@@ -1,0 +1,312 @@
+"""AOT decision serving (sparksched_tpu/serve, ISSUE 10): AOT-vs-jit
+step-exactness, donated-buffer aliasing, the warm-path zero-recompile
+pin, session lifecycle + health quarantine, and the micro-batching
+front. Shapes are tiny (6-job cap, capacity 6) — the serve programs
+are shape-polymorphic and the production store differs only in buffer
+widths — and the expensive compiles are amortized behind module-scoped
+fixtures."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparksched_tpu.config import EnvParams
+from sparksched_tpu.env import core
+from sparksched_tpu.env.flat_loop import init_loop_state
+from sparksched_tpu.env.health import H_NONFINITE_TIME
+from sparksched_tpu.schedulers import DecimaScheduler
+from sparksched_tpu.serve import (
+    MicroBatcher,
+    SessionError,
+    SessionQuarantined,
+    SessionStore,
+    aot_compile,
+    serve_decide_fn,
+)
+from sparksched_tpu.serve.aot import abstract_like
+from sparksched_tpu.workload import make_workload_bank
+
+_i32 = jnp.int32
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = EnvParams(
+        num_executors=5, max_jobs=6, max_stages=20, max_levels=20,
+        mean_time_limit=None,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    params = params.replace(
+        max_stages=bank.max_stages, max_levels=bank.max_stages
+    )
+    sched = DecimaScheduler(
+        num_executors=params.num_executors, embed_dim=8,
+        gnn_mlp_kwargs={"hid_dims": [16]},
+        policy_mlp_kwargs={"hid_dims": [16]},
+        job_bucket=4,
+    )
+    return params, bank, sched
+
+
+@pytest.fixture(scope="module")
+def store(setup):
+    params, bank, sched = setup
+    return SessionStore(
+        params, bank, sched, capacity=6, max_batch=3, seed=0
+    )
+
+
+def _tiny_store_state(params, bank, capacity=2):
+    ls = init_loop_state(core.reset(params, bank, jax.random.PRNGKey(7)))
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (capacity,) + a.shape).copy(), ls
+    )
+
+
+# ---------------------------------------------------------------------------
+# AOT path correctness: exactness, donation, zero recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_aot_step_exact_vs_jit_and_donation_aliasing(setup):
+    """The AOT-compiled serve program is bit-identical to the plain
+    jit path at fixed seeds (same store, same key => same decision and
+    same post-state), AND the donated store is consumed: its input
+    leaves are deleted and the output reuses the input buffer (the
+    zero-allocation steady state the donation exists for)."""
+    params, bank, sched = setup
+    pol, _ = sched.serve_policies(deterministic=False)  # rng-sensitive
+    fn = serve_decide_fn(params, bank, pol)
+    st = _tiny_store_state(params, bank)
+    key = jax.random.PRNGKey(3)
+    args = (_i32(1), key, _i32(-1), _i32(0), jnp.bool_(False))
+
+    st_jit = jax.tree_util.tree_map(jnp.copy, st)
+    out_jit = jax.jit(fn)(st_jit, *args)  # no donation: the reference
+
+    compiled, _secs = aot_compile(
+        fn, abstract_like(st), *[abstract_like(a) for a in args],
+        donate_store=True,
+    )
+    leaves_in = jax.tree_util.tree_leaves(st)
+    big = max(
+        range(len(leaves_in)), key=lambda i: leaves_in[i].nbytes
+    )
+    ptr_in = leaves_in[big].unsafe_buffer_pointer()
+    st_aot, out_aot = compiled(st, *args)
+
+    # step-exactness: decision fields and the full post-call store
+    ref_st, ref_out = out_jit
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_out),
+        jax.tree_util.tree_leaves(out_aot),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_st),
+        jax.tree_util.tree_leaves(st_aot),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # donation: every donated input leaf is dead, and the largest
+    # output leaf lives in the input's buffer (true in-place update)
+    assert all(l.is_deleted() for l in leaves_in)
+    leaves_out = jax.tree_util.tree_leaves(st_aot)
+    assert leaves_out[big].unsafe_buffer_pointer() == ptr_in
+
+
+def test_warm_path_records_zero_recompiles(store, tmp_path,
+                                           monkeypatch):
+    """After the constructor's warmup, serving decisions triggers no
+    JIT activity at all: with the runlog recompile hooks installed (at
+    threshold 0, so even trivial compiles would land), a window of
+    warm single + batched decisions writes no jit_compile records."""
+    import json
+
+    from sparksched_tpu.obs import runlog as runlog_mod
+
+    monkeypatch.setattr(runlog_mod, "JIT_MIN_SECS", 0.0)
+    sids = [store.create(seed=10 + i) for i in range(3)]
+    # absorb first-occurrence host glue (fold_in etc.) outside the
+    # pinned window
+    store.decide(sids[0])
+    store.decide_batch(sids)
+
+    rl = runlog_mod.RunLog(str(tmp_path / "serve.jsonl"))
+    rl.install_jit_hooks()
+    for _ in range(5):
+        store.decide(sids[0])
+        store.decide_batch(sids)
+    rl.close()
+    recs = [json.loads(ln) for ln in open(rl.path)]
+    compiles = [r for r in recs if r["ev"].startswith("jit_compile")]
+    assert compiles == [], compiles
+    for s in sids:
+        store.close(s)
+
+
+# ---------------------------------------------------------------------------
+# session API
+# ---------------------------------------------------------------------------
+
+
+def test_session_lifecycle_and_batch_consistency(store):
+    """create/decide/step/close semantics, and the micro-batched path
+    agrees with the unbatched path: two sessions created from the SAME
+    seed serve the SAME greedy decision whether they ride the batch=K
+    program or the single-session program."""
+    a = store.create(seed=42)
+    b = store.create(seed=42)
+    c = store.create(seed=43)
+
+    ra = store.decide(a)
+    assert ra.decided and not ra.batched
+    [rb, rc] = store.decide_batch([b, c])
+    assert rb.batched and rb.decided
+    # equal states, greedy policy => equal decisions across paths
+    assert (rb.stage_idx, rb.num_exec) == (ra.stage_idx, ra.num_exec)
+
+    # step: a caller-forced action through the same compiled program
+    rs = store.step(c, rc.stage_idx, 1)
+    assert rs.decided
+    assert rs.lgprob == 0.0  # forced actions carry no policy log-prob
+
+    store.close(a)
+    with pytest.raises(SessionError):
+        store.decide(a)
+    with pytest.raises(ValueError):
+        store.decide_batch([b, b])  # duplicate ids in one batch
+    # single-session batches fall back to the unbatched program
+    calls_before = store.stats["serve_batch_calls"]
+    [r1] = store.decide_batch([b])
+    assert not r1.batched
+    assert store.stats["serve_batch_calls"] == calls_before
+    store.close(b)
+    store.close(c)
+
+
+def test_poisoned_session_is_quarantined_not_served(store):
+    """The per-decision health sentinel (ISSUE 9 mask) quarantines: a
+    poisoned session's decide reports the tripped mask, and every
+    later decide/step refuses with SessionQuarantined; close() still
+    reclaims the slot."""
+    sid = store.create(seed=77)
+    ok = store.create(seed=78)
+    # poison the persistent per-job completion clock with NaN — the
+    # H_NONFINITE_TIME class a corrupted device buffer would show
+    env = store._store.env
+    store._store = store._store.replace(
+        env=env.replace(
+            job_t_completed=env.job_t_completed.at[sid].set(jnp.nan)
+        )
+    )
+    r = store.decide(sid)
+    assert r.health_mask & H_NONFINITE_TIME
+    q_before = store.stats["serve_quarantines"]
+    assert q_before >= 1
+    with pytest.raises(SessionQuarantined):
+        store.decide(sid)
+    with pytest.raises(SessionQuarantined):
+        store.step(sid, 0, 1)
+    with pytest.raises(SessionQuarantined):
+        store.decide_batch([ok, sid])
+    # the healthy session keeps serving; quarantine didn't spread
+    assert store.decide(ok).health_mask == 0
+    assert store.stats["serve_quarantines"] == q_before
+    store.close(sid)
+    store.close(ok)
+
+
+def test_store_capacity_exhaustion(store):
+    sids = []
+    while True:
+        try:
+            sids.append(store.create())
+        except RuntimeError:
+            break
+    assert len(sids) == store.capacity
+    for s in sids:
+        store.close(s)
+
+
+# ---------------------------------------------------------------------------
+# micro-batching front
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_flushes_on_full_batch_and_linger(store):
+    sids = [store.create(seed=90 + i) for i in range(3)]
+    mb = MicroBatcher(store, linger_ms=1e6)  # linger effectively off
+    t1, t2 = mb.submit(sids[0]), mb.submit(sids[1])
+    assert not t1.ready and not t2.ready  # below max_batch: queued
+    t3 = mb.submit(sids[2])  # max_batch reached: immediate flush
+    assert t1.ready and t2.ready and t3.ready
+    assert t1.result.batched
+
+    # bounded linger: a lone request flushes once the window expires
+    mb = MicroBatcher(store, linger_ms=0.0)
+    tk = mb.submit(sids[0])
+    assert not tk.ready  # one pending < max_batch: no flush yet
+    assert mb.poll()  # linger (0 ms) already expired
+    assert tk.ready and not tk.result.batched  # lone => unbatched path
+    for s in sids:
+        store.close(s)
+
+
+def test_batcher_duplicates_and_failures_resolve_every_ticket(store):
+    """A duplicate session id in one linger window rides a SUCCESSIVE
+    batch call (two decisions for one session are sequential by
+    definition), and an unservable request fails only ITS ticket —
+    co-batched healthy requests are still served, never orphaned."""
+    a = store.create(seed=200)
+    b = store.create(seed=201)
+    mb = MicroBatcher(store, linger_ms=1e6)
+    t1, t2, t3 = mb.submit(a), mb.submit(a), mb.submit(b)
+    mb.flush()
+    assert t1.ready and t2.ready and t3.ready
+    assert all(t.error is None for t in (t1, t2, t3))
+    assert t2.result.wall_time >= t1.result.wall_time  # sequential
+
+    store.close(b)  # b is now unservable; a must still be served
+    mb = MicroBatcher(store, linger_ms=1e6)
+    ta, tb = mb.submit(a), mb.submit(b)
+    mb.flush()
+    assert ta.ready and ta.error is None and ta.result.decided
+    assert tb.ready and isinstance(tb.error, SessionError)
+    store.close(a)
+
+
+# ---------------------------------------------------------------------------
+# serve: config block + bench row schema helpers
+# ---------------------------------------------------------------------------
+
+
+def test_store_from_config_rejects_unknown_keys(setup):
+    from sparksched_tpu.serve import store_from_config
+
+    params, bank, sched = setup
+    with pytest.raises(ValueError, match="unknown serve"):
+        store_from_config(
+            {"capcity": 4}, params, bank, sched  # typo'd knob
+        )
+
+
+def test_latency_row_blocks():
+    """The `latency` bench row's building blocks: the percentile block
+    schema (PERF.md round 13) and the UNAVAILABLE guard on the
+    on-chip-only fields, so CPU rows are complete and self-describing."""
+    import bench_decima
+
+    block = bench_decima._latency_block([1.0, 2.0, 3.0, 100.0], 4)
+    assert set(block) == {
+        "p50_ms", "p90_ms", "p99_ms", "mean_ms", "max_ms", "reps",
+    }
+    assert block["p50_ms"] <= block["p90_ms"] <= block["p99_ms"]
+    chip = bench_decima._on_chip_block()
+    assert "device_memory" in chip
+    if jax.default_backend() == "cpu":
+        assert isinstance(chip["device_memory"], str)
+        assert chip["device_memory"].startswith("UNAVAILABLE")

@@ -45,6 +45,9 @@ def test_shipped_tree_is_analysis_clean():
         # default-off programs above pin that health off changes
         # nothing
         "ppo_update_health", "flat_collect_batch_health",
+        # ISSUE 10: the AOT decision-serving programs (serve/aot.py),
+        # audited exactly as the session store lowers them
+        "serve_decide", "serve_decide_batch",
     }
     assert set(report["passes"]["jaxpr"]["measured"]) == all_programs
     mem = report["passes"]["memory"]["measured"]
