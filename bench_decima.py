@@ -359,18 +359,13 @@ def bench_inference(
 
 def _latency_block(samples_ms: list[float], reps: int) -> dict:
     """The `latency` row's percentile block (PERF.md round 13 schema):
-    per-decision wall-time percentiles over `reps` timed calls."""
-    import numpy as np
+    per-decision wall-time percentiles over `reps` timed calls. Since
+    round 14 this is the shared `obs.metrics.percentile_block` helper
+    (exact numpy percentiles, identical keys/values to the r10 rows —
+    the refactor must keep old and new artifacts comparable)."""
+    from sparksched_tpu.obs.metrics import percentile_block
 
-    a = np.asarray(samples_ms, dtype=np.float64)
-    return {
-        "p50_ms": round(float(np.percentile(a, 50)), 4),
-        "p90_ms": round(float(np.percentile(a, 90)), 4),
-        "p99_ms": round(float(np.percentile(a, 99)), 4),
-        "mean_ms": round(float(a.mean()), 4),
-        "max_ms": round(float(a.max()), 4),
-        "reps": int(reps),
-    }
+    return percentile_block(samples_ms, reps=reps)
 
 
 def _on_chip_block() -> dict:
@@ -390,6 +385,35 @@ def _on_chip_block() -> dict:
             ),
         }
     return {"device_memory": stats}
+
+
+def _serve_setup():
+    """(params, bank, sched) for the serving benches — the BASELINE.md
+    config #3 env at the PR-3 CPU-calibrated compaction bucket, shared
+    by `bench_serve_latency` and `bench_serve_scale` so the two row
+    families measure the same store."""
+    params = EnvParams(
+        num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
+        moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
+        mean_time_limit=None,
+    )
+    bank = make_workload_bank(params.num_executors, params.max_stages)
+    if bank.max_stages != params.max_stages:
+        params = params.replace(
+            max_stages=bank.max_stages, max_levels=bank.max_stages
+        )
+    sched = DecimaScheduler(
+        num_executors=params.num_executors,
+        embed_dim=16,
+        gnn_mlp_kwargs={
+            "hid_dims": [32, 16],
+            "act_cls": "LeakyReLU",
+            "act_kwargs": {"negative_slope": 0.2},
+        },
+        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
+        job_bucket=16,  # the PR-3 CPU calibration winner
+    )
+    return params, bank, sched
 
 
 def bench_serve_latency(
@@ -425,27 +449,7 @@ def bench_serve_latency(
     from sparksched_tpu.obs.runlog import RunLog
     from sparksched_tpu.serve import MicroBatcher, SessionStore
 
-    params = EnvParams(
-        num_executors=10, max_jobs=50, max_stages=20, max_levels=20,
-        moving_delay=2000.0, warmup_delay=1000.0, job_arrival_rate=4e-5,
-        mean_time_limit=None,
-    )
-    bank = make_workload_bank(params.num_executors, params.max_stages)
-    if bank.max_stages != params.max_stages:
-        params = params.replace(
-            max_stages=bank.max_stages, max_levels=bank.max_stages
-        )
-    sched = DecimaScheduler(
-        num_executors=params.num_executors,
-        embed_dim=16,
-        gnn_mlp_kwargs={
-            "hid_dims": [32, 16],
-            "act_cls": "LeakyReLU",
-            "act_kwargs": {"negative_slope": 0.2},
-        },
-        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
-        job_bucket=16,  # the PR-3 CPU calibration winner
-    )
+    params, bank, sched = _serve_setup()
     runlog = RunLog.create("artifacts", name=None)
     t0 = time.perf_counter()
     store = SessionStore(
@@ -482,7 +486,13 @@ def bench_serve_latency(
 
     def emit(metric: str, samples_ms: list[float], cfg_extra: dict
              ) -> None:
+        from sparksched_tpu.obs.metrics import hist_summary
+
         lat = _latency_block(samples_ms, len(samples_ms)) | cold
+        # round-14 satellite: the O(buckets) streaming-histogram block
+        # NEXT TO the exact percentiles (same samples; the exact
+        # p50/p90/p99 fields above are unchanged from the r10 schema)
+        lat["hist"] = hist_summary(samples_ms)
         if cfg_extra.get("batch", 1) > 1:
             lat["per_decision_p50_ms"] = round(
                 lat["p50_ms"] / cfg_extra["batch"], 4
@@ -577,6 +587,265 @@ def bench_serve_latency(
     runlog.close()
     print(f"# bench_decima: wrote {artifact} ({len(rows)} rows)",
           file=sys.stderr, flush=True)
+    return rows
+
+
+def _serve_obs_overhead(store, reps: int = 30) -> dict:
+    """Instrumentation A/B on the serve path (ISSUE 11 acceptance bar:
+    <= 5%): time `reps` warm full-batch flush windows through an
+    UNinstrumented MicroBatcher vs a fully instrumented one (metrics +
+    per-request tracing + runlog trace records), interleaved medians —
+    the scripts_obs_demo.py protocol, so box-level drift hits both
+    arms equally."""
+    import tempfile
+
+    from sparksched_tpu.obs.metrics import MetricsRegistry, interleaved_ab
+    from sparksched_tpu.obs.runlog import RunLog
+    from sparksched_tpu.serve import MicroBatcher
+
+    sids = [store.create(seed=9000 + i) for i in range(store.max_batch)]
+    rl = RunLog(
+        os.path.join(tempfile.mkdtemp(prefix="serve_ab_"), "ab.jsonl")
+    )
+
+    def rotate(results):
+        nonlocal sids
+        if any(r.done or r.health_mask for r in results):
+            for s in sids:
+                store.close(s)
+            sids = [
+                store.create(seed=9500 + i)
+                for i in range(store.max_batch)
+            ]
+
+    def window(mb):
+        t0 = time.perf_counter()
+        tks = [mb.submit(s) for s in sids]  # full batch => auto-flush
+        dt = time.perf_counter() - t0
+        rotate([t.result for t in tks if t.result is not None])
+        return dt
+
+    def arm_off():
+        store.metrics, store.trace = None, False
+        return window(MicroBatcher(store, linger_ms=1e6))
+
+    def arm_on():
+        store.metrics, store.trace = MetricsRegistry(), True
+        return window(MicroBatcher(
+            store, linger_ms=1e6, metrics=store.metrics, runlog=rl,
+            trace=True,
+        ))
+
+    t_off, t_on, pct = interleaved_ab(
+        arm_off, arm_on, warmups=2, reps=max(5, reps)
+    )
+    rl.close()
+    for s in sids:
+        store.close(s)
+    store.metrics, store.trace = None, False
+    return {
+        "off_ms": round(t_off * 1e3, 4),
+        "on_ms": round(t_on * 1e3, 4),
+        "overhead_pct": round(pct, 2),
+        "passed": pct < 5.0,
+        "reps": max(5, reps),
+        "protocol": "interleaved medians over warm full-batch flush "
+                    "windows (scripts_obs_demo.py protocol); on = "
+                    "metrics registry + per-request trace spans + "
+                    "runlog trace records",
+    }
+
+
+def bench_serve_scale(
+    artifact: str = "artifacts/serve_scale_r11.json",
+) -> list[dict]:
+    """Serving at load (ISSUE 11): open-loop offered-load sweep over
+    the AOT session store + micro-batching front, reporting GOODPUT
+    under a p99 SLO — replies within `slo_ms` of their SCHEDULED
+    arrival per second of run — and the p99-vs-offered-load curve.
+    One `serve_scale` JSON row per offered-load point (plus one bursty
+    MMPP row at the midpoint rate); every row carries the per-request
+    trace span summary and the admission/occupancy metrics (queue
+    depth, batch K-fill, linger waits, flush reasons, quarantines,
+    capacity rejections) from the instrumented front, and the full set
+    lands in `artifact` with the protocol + the instrumentation-
+    overhead A/B. Arrival schedules are seeded and deterministic
+    (serve/loadgen.py); latency is measured open-loop, so offered
+    loads beyond capacity show the queueing tail closed-loop medians
+    can never see."""
+    offered = [
+        float(x) for x in os.environ.get(
+            "SERVE_SCALE_OFFERED", "12.5,25,50,100,200"
+        ).split(",") if x.strip()
+    ]
+    n_req = int(os.environ.get("SERVE_SCALE_REQUESTS", 240))
+    tenants = int(os.environ.get("SERVE_SCALE_TENANTS", 12))
+    slo_ms = float(os.environ.get("SERVE_SCALE_SLO_MS", 200))
+    linger_ms = float(os.environ.get("SERVE_SCALE_LINGER_MS", 2))
+    capacity = int(os.environ.get("SERVE_SCALE_CAPACITY", 32))
+    max_batch = int(os.environ.get("SERVE_SCALE_BATCH", 8))
+    with_mmpp = os.environ.get("SERVE_SCALE_MMPP", "1") == "1"
+    seed = int(os.environ.get("SERVE_SCALE_SEED", 11))
+
+    from sparksched_tpu.obs.metrics import (
+        MetricsRegistry,
+        hist_summary,
+        percentile_block,
+    )
+    from sparksched_tpu.obs.runlog import RunLog
+    from sparksched_tpu.serve import (
+        MicroBatcher,
+        SessionStore,
+        generate_arrivals,
+        run_open_loop,
+    )
+
+    params, bank, sched = _serve_setup()
+    runlog = RunLog.create("artifacts", name=None)
+    t0 = time.perf_counter()
+    store = SessionStore(
+        params, bank, sched, capacity=capacity, max_batch=max_batch,
+        deterministic=True, seed=0, runlog=runlog,
+    )
+    cold_start_s = time.perf_counter() - t0
+
+    base_cfg = {
+        "capacity": capacity,
+        "max_batch": max_batch,
+        "linger_ms": linger_ms,
+        "tenants": tenants,
+        "requests": n_req,
+        "seed": seed,
+        "engine": "serve",
+        "deterministic": True,
+        "job_bucket": sched.job_bucket,
+        "dtype": bank_dtype_label(bank),
+        "obs_dtype": params.obs_dtype,
+        "prng_impl": str(jax.config.jax_default_prng_impl),
+        "backend": jax.default_backend(),
+    }
+    rows: list[dict] = []
+    points = [(r, "poisson") for r in offered]
+    if with_mmpp and offered:
+        points.append((offered[len(offered) // 2], "mmpp"))
+
+    for rate, process in points:
+        arrivals = generate_arrivals(
+            rate, n_req, tenants, process=process, seed=seed
+        )
+        reg = MetricsRegistry()
+        store.metrics, store.trace = reg, True
+        mb = MicroBatcher(
+            store, linger_ms=linger_ms, metrics=reg, runlog=runlog,
+            trace=True,
+        )
+        summary = run_open_loop(
+            store, mb, arrivals, slo_ms=slo_ms,
+            session_seed=20_000 + int(rate),
+        )
+        samples = summary.pop("samples_ms")
+        hist = summary.pop("hist")
+        snap = reg.snapshot()
+        lat_block = percentile_block(samples)
+        p99 = lat_block["p99_ms"]
+        tag = "_mmpp" if process == "mmpp" else ""
+        row = {
+            "metric": f"serve_scale_offered{rate:g}rps{tag}",
+            # the headline value IS goodput: SLO-satisfying decisions/s
+            "value": summary["goodput_rps"],
+            "unit": "decisions/s",
+            "slo": {
+                "p99_slo_ms": slo_ms,
+                "p99_ms": p99,
+                "slo_met": p99 <= slo_ms,
+                "good": summary["good"],
+                "good_fraction": round(
+                    summary["good"] / max(summary["completed"], 1), 4
+                ),
+                "goodput_rps": summary["goodput_rps"],
+            },
+            "open_loop": {
+                k: summary[k] for k in (
+                    "requests", "completed", "errors", "makespan_s",
+                    "offered_rps", "achieved_rps", "session_rotations",
+                    "capacity_rejections",
+                )
+            },
+            "latency": lat_block | {"hist": hist_summary(hist)},
+            # the trace stamp: per-span latency summaries from the
+            # instrumented front (queue wait / device compute /
+            # scatter-back / total), one histogram each
+            "trace": {
+                k: v for k, v in snap["hists"].items()
+                if k.startswith("serve_span_")
+            },
+            # the metrics stamp: admission/occupancy views + counters
+            "metrics": {
+                "queue_depth": snap["hists"].get("serve_queue_depth"),
+                "batch_occupancy": snap["hists"].get(
+                    "serve_batch_occupancy"
+                ),
+                "linger_wait_ms": snap["hists"].get(
+                    "serve_linger_wait_ms"
+                ),
+                "flush_reasons": {
+                    k.removeprefix("serve_flush_"): int(v)
+                    for k, v in snap["counters"].items()
+                    if k.startswith("serve_flush_")
+                },
+                "quarantines": int(
+                    snap["counters"].get("serve_quarantines", 0)
+                ),
+                # store-side create() failures (one per rotation
+                # attempt) — request-level rejections live in
+                # open_loop.capacity_rejections; the two counters
+                # measure different events and are named apart
+                "store_create_rejections": int(
+                    snap["counters"].get(
+                        "serve_capacity_rejections", 0
+                    )
+                ),
+                "rejected_requests": int(
+                    snap["counters"].get("serve_requests_rejected", 0)
+                ),
+            },
+            "analysis_clean": analysis_clean_stamp(),
+            "config": base_cfg | {
+                "offered_rps": rate, "process": process,
+                "cold_start_s": round(cold_start_s, 3),
+            },
+            "on_chip": _on_chip_block(),
+        }
+        rows.append(row)
+        runlog.metrics(snap, metric=row["metric"])
+        print(json.dumps(row), flush=True)
+
+    overhead = _serve_obs_overhead(store)
+    os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+    with open(artifact, "w") as fp:
+        json.dump({
+            "protocol": {
+                "slo_ms": slo_ms,
+                "goodput": "replies within slo_ms of their SCHEDULED "
+                           "arrival, per second of run (open-loop: "
+                           "queue wait counts against the server)",
+                "open_loop": "seeded deterministic arrival schedule "
+                             "(serve/loadgen.py), never "
+                             "back-pressured by response times",
+                "arrival_processes": sorted({p for _, p in points}),
+                "requests_per_point": n_req,
+                "offered_sweep_rps": offered,
+                "obs_overhead": overhead,
+            },
+            "rows": rows,
+        }, fp, indent=1)
+    runlog.close()
+    print(
+        f"# bench_decima: wrote {artifact} ({len(rows)} rows; obs "
+        f"overhead {overhead['overhead_pct']:+.2f}% "
+        f"{'PASS' if overhead['passed'] else 'FAIL'} vs 5% bar)",
+        file=sys.stderr, flush=True,
+    )
     return rows
 
 
@@ -756,3 +1025,9 @@ if __name__ == "__main__":
     # chip-session stage 14 at the 1024-session scale)
     if os.environ.get("SERVE_BENCH", "1") == "1":
         bench_serve_latency()
+    # ISSUE 11: open-loop goodput@SLO rows (offered-load sweep through
+    # the seeded load generator + instrumented micro-batching front);
+    # SERVE_SCALE_BENCH=0 skips (the rows also run standalone from
+    # chip-session stage 15 at chip scale)
+    if os.environ.get("SERVE_SCALE_BENCH", "1") == "1":
+        bench_serve_scale()

@@ -571,6 +571,77 @@ def stage_serve_latency():
     print(f"[serve-latency] subprocess rc={r.returncode}", flush=True)
 
 
+def stage_serve_scale():
+    """ISSUE 11: on-chip open-loop goodput@SLO capture — the offered-
+    load sweep through the seeded load generator + instrumented
+    micro-batching front (`bench_decima.bench_serve_scale`), written
+    as `serve_scale` rows + artifacts/serve_scale_r11.json. Runs
+    ENTIRELY in a subprocess, gate included (counting devices claims
+    the client); a chipless host prints an explicit
+    `[serve-scale] UNAVAILABLE` marker and exits 0 — the watcher log
+    must distinguish "no window" from "never ran". The CPU sweep at
+    the default scale lives in PERF.md round 14; this stage is the
+    on-chip confirmation slot. Chip-scale knobs (more tenants, higher
+    offered loads, a tighter SLO — the chip's per-decision latency is
+    ~ms, not ~100 ms) default below; every one is env-overridable."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    if _client_held():
+        print("[serve-scale] parent process already holds a device "
+              "client; run stage 15 as its own invocation", flush=True)
+        return
+    repo = osp.dirname(osp.abspath(__file__))
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from sparksched_tpu.config import (\n"
+        "    enable_compilation_cache, honor_jax_platforms_env,\n"
+        "    use_fast_prng,\n"
+        ")\n"
+        "honor_jax_platforms_env()\n"
+        "enable_compilation_cache()\n"
+        "if os.environ.get('BENCH_PRNG', 'rbg') == 'rbg':\n"
+        "    use_fast_prng()\n"
+        "import jax\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    print('[serve-scale] UNAVAILABLE: cpu backend only; "
+        "the chip-scale open-loop goodput rows need a chip window "
+        "(the CPU sweep is recorded in PERF.md round 14)', "
+        "flush=True)\n"
+        "    sys.exit(0)\n"
+        "import bench_decima\n"
+        "bench_decima.bench_serve_scale()\n"
+    )
+    env = os.environ | {
+        # chip-scale open loop: 64 tenants on a 128-slot store, the
+        # sweep pushed past the chip's serving capacity so the curve
+        # shows the same knee the CPU round recorded
+        "SERVE_SCALE_CAPACITY": os.environ.get(
+            "SERVE_SCALE_CAPACITY", "128"
+        ),
+        "SERVE_SCALE_BATCH": os.environ.get("SERVE_SCALE_BATCH", "16"),
+        "SERVE_SCALE_TENANTS": os.environ.get(
+            "SERVE_SCALE_TENANTS", "64"
+        ),
+        "SERVE_SCALE_REQUESTS": os.environ.get(
+            "SERVE_SCALE_REQUESTS", "2000"
+        ),
+        "SERVE_SCALE_OFFERED": os.environ.get(
+            "SERVE_SCALE_OFFERED", "250,500,1000,2000,4000"
+        ),
+        "SERVE_SCALE_SLO_MS": os.environ.get(
+            "SERVE_SCALE_SLO_MS", "25"
+        ),
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, timeout=2700, env=env,
+    )
+    print(f"[serve-scale] subprocess rc={r.returncode}", flush=True)
+
+
 # ---------------------------------------------------------------------------
 # stage-completion ledger (ISSUE 9 preemption safety)
 # ---------------------------------------------------------------------------
@@ -646,6 +717,7 @@ STAGES = {
     "12": ("sharded multichip bench", stage_multichip_bench),
     "13": ("fused-engine headline bench", stage_fused_headline),
     "14": ("serving-latency capture", stage_serve_latency),
+    "15": ("serve-scale open-loop capture", stage_serve_scale),
 }
 
 

@@ -33,6 +33,8 @@ rm -f /tmp/multichip_done
 rm -f /tmp/fused_headline_done
 # ... and for the serving-latency capture (stage 14, ISSUE 10)
 rm -f /tmp/serve_latency_done
+# ... and for the serve-scale open-loop capture (stage 15, ISSUE 11)
+rm -f /tmp/serve_scale_done
 # stage-completion ledger (ISSUE 9): per-LIFETIME like the markers
 # above — a restarted watcher must re-run its multi-stage sessions, not
 # inherit a previous lifetime's completions (the ledger's job is
@@ -253,6 +255,22 @@ print('ALIVE')
       echo "serve-latency rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
       grep -q '"backend": "tpu"' /tmp/serve_latency_last.log \
         && touch "$SERVE_MARK"
+    fi
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time serve-scale open-loop capture (ISSUE 11, stage 15): the
+    # offered-load sweep through the seeded load generator against the
+    # chip-scale session store — goodput under the p99 SLO plus the
+    # p99-vs-offered-load curve, the on-chip partner of the CPU sweep
+    # in PERF.md round 14. Once per watcher lifetime; marked done only
+    # when a TPU-backed row landed (an UNAVAILABLE marker means no
+    # window yet — retry next loop, like the stage-13/14 slots).
+    SERVE_SCALE_MARK=/tmp/serve_scale_done
+    if [ ! -f "$SERVE_SCALE_MARK" ]; then
+      timeout -k 60 2700 python scripts_chip_session.py 15 \
+        | tee /tmp/serve_scale_last.log
+      echo "serve-scale rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q '"backend": "tpu"' /tmp/serve_scale_last.log \
+        && touch "$SERVE_SCALE_MARK"
     fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable

@@ -55,8 +55,15 @@ HOT_DIRS = ("env", "schedulers")
 # (ISSUE 10): its device_get/block_until_ready ARE the product — the
 # caller is handed a concrete decision — and its traced code lives in
 # serve/aot.py + env/, which the jaxpr rules audit directly.
+# serve/loadgen.py (ISSUE 11) is host-side by definition — an
+# open-loop load generator IS a wall-clock consumer
+# (time.perf_counter is its measurement instrument, not a trace
+# hazard); obs/metrics.py needs no entry here because obs/ is already
+# a sync-exempt host dir, but both are named so the scoping decision
+# is visible in one place.
 HOST_FILES = frozenset({
     "renderer.py", "env/gym_compat.py", "serve/session.py",
+    "serve/loadgen.py",
 })
 
 # host-side entry points inside otherwise-hot modules, PATH-QUALIFIED

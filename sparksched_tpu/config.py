@@ -145,6 +145,22 @@ SERVE_KEYS = frozenset({
     "deterministic",  # greedy serving (default True)
     "donate",  # donate the store buffer to the serve programs
     "seed",  # base key for session resets / sampling
+    # ISSUE 11 instrumentation (default off, zero-cost off):
+    "trace",  # per-request span stamps + runlog `trace` records
+    "metrics",  # attach an obs.metrics.MetricsRegistry to the store
+})
+
+OBS_KEYS = frozenset({
+    # the top-level `obs:` block (ISSUE 2; consumed by the trainer) —
+    # validated since ISSUE 11 with the same fail-loud contract as
+    # health:/chaos:/serve: (a typo'd observability knob silently
+    # running blind is the quiet failure this subsystem removes)
+    "runlog",  # true|false|path — the JSONL event-stream sink
+    "telemetry",  # thread on-device engine counters per iteration
+    "memory",  # per-iteration device-allocator sample (default True)
+    "trace_iteration",  # capture a labeled device trace of iteration N
+    "trace_dir",  # where that trace lands
+    "runlog_max_bytes",  # size-cap + numbered-suffix runlog rotation
 })
 
 CHAOS_KEYS = frozenset({

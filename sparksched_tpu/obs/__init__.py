@@ -24,9 +24,23 @@ Three parts (ISSUE 2 tentpole), each usable on its own:
   TPU tiled-layout model, the lane-fit advisor (max vmap lanes under
   an HBM budget), and runtime `device_memory_stats()` for stamping
   bench rows and trainer iterations.
+- `metrics`: streaming serving metrics (ISSUE 11) — log-bucketed
+  mergeable histograms (p50..p999 in O(buckets) memory, so
+  million-request open-loop runs never retain samples) and a
+  counter/gauge/histogram `MetricsRegistry` with Prometheus-text and
+  runlog-JSONL exporters; `tracing` additionally carries the
+  per-request `RequestTrace` span clock the serving front stamps
+  (submit -> batch_admit -> dispatch -> device_compute ->
+  scatter_back -> reply, the runlog `trace` record kind).
 """
 
 from .memory import device_memory_stats, lane_fit  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    StreamingHistogram,
+    hist_summary,
+    percentile_block,
+)
 from .runlog import RunLog, emit  # noqa: F401
 from .telemetry import Telemetry, summarize, telemetry_zeros  # noqa: F401
-from .tracing import annotate  # noqa: F401
+from .tracing import RequestTrace, annotate  # noqa: F401

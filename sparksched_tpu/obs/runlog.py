@@ -20,6 +20,15 @@ kind) and `t` (unix seconds); the kinds the trainer/bench write:
   cold-start fields; `sparksched_tpu/serve/` sessions additionally
   write per-iteration `serve_*` scalars through the standard
   `scalars` record (TensorBoard-mirrored like the trainer's)
+- `trace`: one served request's Dapper-style span walk (ISSUE 11) —
+  the `trace_id` minted at `Ticket` creation plus per-phase offsets
+  in ms from submit (`submit` -> `batch_admit` -> `dispatch` ->
+  `device_compute` -> `scatter_back` -> `reply`) and `total_ms`;
+  written by the instrumented `MicroBatcher`, off by default
+- `metrics`: a `MetricsRegistry` snapshot (obs/metrics.py) — the
+  JSONL half of the exporter pair (counters / gauges / streaming-
+  histogram summaries nested under `snapshot`); the Prometheus text
+  form is `MetricsRegistry.to_prometheus`
 - `health`: a tripped in-JIT health sentinel (ISSUE 9) — the raw i32
   violation bitmask (`mask`), its decoded `bits` (env/health.py bit
   table), the `iteration`/`attempt` it quarantines, and the recovery
@@ -37,6 +46,15 @@ are closed (a final `run_end` with a `teardown` reason) from an
 `atexit` hook and — when the process had no handler of its own — a
 chained SIGTERM handler, so a watcher-timeout-killed run keeps its
 partial telemetry instead of losing the tail.
+
+Rotation (ISSUE 11): `max_bytes` caps the active file — a write that
+pushes past it renames the file to `<path>.<n>` (numbered suffix,
+monotone across process restarts) and reopens `<path>` fresh with a
+`rotate` continuation record, so a million-request open-loop run can
+never grow one unbounded JSONL. Rotated segments are complete (every
+record was flushed when written) and the crash-safety guarantees are
+unchanged: teardown stamps `run_end` into the ACTIVE file and never
+rotates (the signal path must not rename/reopen mid-kill).
 
 Readers: `PERF.md` "Reading a run" documents the schema; a runlog is
 greppable (`grep '"ev": "telemetry"' run.jsonl | tail -1`) and loads
@@ -103,19 +121,36 @@ class RunLog:
     """Append-only JSONL writer (thread-safe; the JIT hooks fire from
     whatever thread compiles)."""
 
-    def __init__(self, path: str, echo: bool = False) -> None:
+    def __init__(self, path: str, echo: bool = False,
+                 max_bytes: int | None = None) -> None:
         os.makedirs(osp.dirname(osp.abspath(path)), exist_ok=True)
         self.path = path
         self.echo = echo
+        self.max_bytes = int(max_bytes) if max_bytes else None
         self._lock = threading.Lock()
         self._fp = open(path, "a")
         self._closed = False
+        # resume rotation numbering past any suffixes already on disk
+        # (RunLog appends; clobbering an earlier run's `.1` would break
+        # the "rotated segments are complete" promise)
+        self._rotations = 0
+        if self.max_bytes:
+            import glob as _glob
+
+            # escape the path itself: a user-supplied runlog path with
+            # glob metachars must not silently restart numbering at 0
+            # (os.replace would then clobber an earlier run's segments)
+            for p in _glob.glob(_glob.escape(path) + ".*"):
+                tail = p[len(path) + 1:]
+                if tail.isdigit():
+                    self._rotations = max(self._rotations, int(tail))
         _OPEN_RUNLOGS.add(self)
         _install_teardown_hooks()
 
     @classmethod
     def create(cls, artifacts_dir: str, name: str | None = None,
-               echo: bool = False) -> "RunLog":
+               echo: bool = False,
+               max_bytes: int | None = None) -> "RunLog":
         """Open `artifacts_dir/runlog/<name>.jsonl`. The default name
         carries pid + a process-local counter on top of the timestamp
         so two runs started within the same second (back-to-back tests,
@@ -128,7 +163,8 @@ class RunLog:
                 f"run-{int(time.time())}-{os.getpid()}-{_CREATE_COUNTER}"
             )
         return cls(
-            osp.join(artifacts_dir, "runlog", f"{name}.jsonl"), echo=echo
+            osp.join(artifacts_dir, "runlog", f"{name}.jsonl"),
+            echo=echo, max_bytes=max_bytes,
         )
 
     # -- record writers ----------------------------------------------------
@@ -144,8 +180,33 @@ class RunLog:
                 return
             self._fp.write(line + "\n")
             self._fp.flush()
+            # run_end must stay the active file's last record (the
+            # schema promise readers and the crash-safety tests pin),
+            # so the closing write never triggers a rotation
+            if (self.max_bytes and ev != "run_end"
+                    and self._fp.tell() >= self.max_bytes):
+                self._rotate_locked()
         if self.echo:
             emit(line)
+
+    def _rotate_locked(self) -> None:
+        """Size-cap rotation (caller holds the lock): rename the full
+        active file to `<path>.<n>` and reopen `<path>` with a
+        `rotate` continuation record. Best-effort — a failed rename
+        (read-only fs mid-run) keeps appending to the active file
+        rather than losing records."""
+        try:
+            self._fp.close()
+            self._rotations += 1
+            os.replace(self.path, f"{self.path}.{self._rotations}")
+            self._fp = open(self.path, "a")
+            cont = {"ev": "rotate", "t": round(time.time(), 3),
+                    "segment": self._rotations,
+                    "prev": f"{self.path}.{self._rotations}"}
+            self._fp.write(json.dumps(cont) + "\n")
+            self._fp.flush()
+        except OSError:
+            self._fp = open(self.path, "a")
 
     def span(self, name: str, **fields: Any) -> "_Span":
         """Context manager timing a block; writes one `span` record with
@@ -194,6 +255,30 @@ class RunLog:
         if phase is not None:
             fields["phase"] = phase
         self.write("latency", **(dict(stats or {}) | fields))
+
+    def trace(self, trace_id: str, spans_ms: dict[str, float],
+              **fields: Any) -> None:
+        """One served request's span walk (ISSUE 11): `spans_ms` maps
+        phase name -> offset in ms from submit (obs/tracing.py:
+        `RequestTrace.offsets_ms`); `total_ms` is stamped from the
+        `reply` offset so a grep can read tail latency without
+        arithmetic."""
+        total = spans_ms.get("reply")
+        self.write(
+            "trace", trace_id=trace_id,
+            spans={k: round(float(v), 4) for k, v in spans_ms.items()},
+            total_ms=None if total is None else round(float(total), 4),
+            **fields,
+        )
+
+    def metrics(self, snapshot: dict[str, Any],
+                iteration: int | None = None, **fields: Any) -> None:
+        """A `MetricsRegistry.snapshot()` (obs/metrics.py) — the JSONL
+        exporter: counters/gauges/histogram summaries nested under
+        `snapshot` (one record per export, like `telemetry`)."""
+        if iteration is not None:
+            fields["iteration"] = int(iteration)
+        self.write("metrics", snapshot=snapshot, **fields)
 
     def memory(self, stats: dict[str, Any],
                iteration: int | None = None, phase: str | None = None,

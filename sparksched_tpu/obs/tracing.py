@@ -29,6 +29,9 @@ guarantees LIFO unwinding even when an inner exit raises;
 from __future__ import annotations
 
 import contextlib
+import itertools
+import os
+import time
 
 
 class annotate:
@@ -55,3 +58,58 @@ class annotate:
         if stack is not None:
             return stack.__exit__(exc_type, exc_val, exc_tb)
         return False
+
+
+# ---------------------------------------------------------------------------
+# per-request span traces (ISSUE 11)
+#
+# The serving path's Dapper-style walk: a trace id minted at `Ticket`
+# creation, one perf_counter stamp per phase as the request moves
+# submit -> batch_admit -> dispatch -> device_compute -> scatter_back
+# -> reply. Host-side only — the compiled serve programs are untouched
+# (the analysis registry pins them byte-identical), and the host
+# phases bracket the device work: `dispatch` is the instant the
+# compiled call is issued, `device_compute` when its outputs are ready
+# (block_until_ready), `scatter_back` when the host has the concrete
+# ServeResults (device_get + un-batching). The instrumented
+# MicroBatcher additionally enters `annotate("serve/flush")` around
+# the dispatch, so a Perfetto capture carries the same phase label the
+# trace records use.
+# ---------------------------------------------------------------------------
+
+SPAN_ORDER = (
+    "submit", "batch_admit", "dispatch", "device_compute",
+    "scatter_back", "reply",
+)
+
+_TRACE_SEQ = itertools.count()
+
+
+class RequestTrace:
+    """One request's spans: `stamp(name)` records a perf_counter time;
+    `offsets_ms()` converts to ms offsets from submit (the runlog
+    `trace` record payload). Trace ids are process-unique and ordered
+    (`t<pid>-<seq>`), deterministic given submission order."""
+
+    __slots__ = ("trace_id", "spans")
+
+    def __init__(self, trace_id: str | None = None) -> None:
+        self.trace_id = (
+            trace_id
+            if trace_id is not None
+            else f"t{os.getpid():x}-{next(_TRACE_SEQ):08d}"
+        )
+        self.spans: dict[str, float] = {}
+
+    def stamp(self, name: str, t: float | None = None) -> None:
+        self.spans[name] = time.perf_counter() if t is None else t
+
+    def offsets_ms(self) -> dict[str, float]:
+        base = self.spans.get("submit")
+        if base is None:
+            return {}
+        return {
+            name: (self.spans[name] - base) * 1e3
+            for name in SPAN_ORDER
+            if name in self.spans
+        }

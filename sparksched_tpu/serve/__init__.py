@@ -4,8 +4,10 @@ The latency side of the engine: persistent per-tenant cluster sessions
 served through ahead-of-time-compiled, buffer-donated decision
 programs, with a bounded-linger micro-batching front riding the
 width-K `batch_policy` compaction. See `serve/aot.py` (the compiled
-programs), `serve/session.py` (the session API), and the README
-"Serving" section for the warmup protocol and knobs.
+programs), `serve/session.py` (the session API), `serve/loadgen.py`
+(seeded open-loop Poisson/MMPP load generation — ISSUE 11), and the
+README "Serving" / "Serving at load" sections for the warmup protocol
+and knobs.
 """
 
 from .aot import (
@@ -15,6 +17,7 @@ from .aot import (
     serve_decide_batch_fn,
     serve_decide_fn,
 )
+from .loadgen import generate_arrivals, run_open_loop
 from .session import (
     MicroBatcher,
     ServeResult,
@@ -31,6 +34,8 @@ __all__ = [
     "serve_callables",
     "serve_decide_batch_fn",
     "serve_decide_fn",
+    "generate_arrivals",
+    "run_open_loop",
     "MicroBatcher",
     "ServeResult",
     "SessionError",

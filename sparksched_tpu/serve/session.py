@@ -35,6 +35,19 @@ deliberately synchronous — `submit` returns a `Ticket`, and `poll()`
 (or a full batch) flushes — so a network front can drive it from any
 event loop and the latency bench can measure it deterministically.
 
+Observability (ISSUE 11): both layers are instrumented, OFF by
+default and zero-cost off — `metrics` (an `obs.metrics.MetricsRegistry`
+or None) receives the admission/occupancy view ORCA-style schedulers
+need (queue depth at flush, batch K-fill, per-request linger waits,
+flush reason size|linger|forced, quarantine and capacity-rejection
+counters), and `trace=True` stamps a Dapper-style per-request span
+walk (trace id minted at `Ticket` creation; submit -> batch_admit ->
+dispatch -> device_compute -> scatter_back -> reply) emitted as
+runlog `trace` records and bridged into the `annotate("serve/flush")`
+named scope. All instrumentation is host-side: the compiled serve
+programs are untouched (the analysis registry pins their jaxprs
+byte-identical with instrumentation off).
+
 Config surface: the top-level `serve:` YAML block
 (`config.SERVE_KEYS`), validated loudly like the `health:`/`chaos:`
 blocks — a typo'd knob must fail, not silently serve with defaults.
@@ -52,6 +65,7 @@ import numpy as np
 from ..config import SERVE_KEYS, EnvParams
 from ..env import core
 from ..env.flat_loop import init_loop_state
+from ..obs.tracing import RequestTrace, annotate
 from ..workload.bank import WorkloadBank
 from .aot import (
     SERVE_KNOBS,
@@ -122,6 +136,8 @@ class SessionStore:
         knobs: dict[str, Any] | None = None,
         runlog=None,
         tb_writer=None,
+        metrics=None,
+        trace: bool = False,
     ) -> None:
         if not 1 <= max_batch <= capacity:
             raise ValueError(
@@ -136,6 +152,15 @@ class SessionStore:
         self.knobs = SERVE_KNOBS | (knobs or {})
         self._runlog = runlog
         self._tb = tb_writer
+        # ISSUE 11 instrumentation — both PUBLIC and reassignable so a
+        # bench can swap a fresh registry per measurement window
+        # without recompiling the store. `trace=True` makes every
+        # compiled call record its phase boundaries into `last_spans`
+        # (dispatch / device_compute / scatter_back perf_counter
+        # stamps) at the cost of one extra host sync per call.
+        self.metrics = metrics
+        self.trace = bool(trace)
+        self.last_spans: dict[str, float] | None = None
         self._base_key = jax.random.PRNGKey(seed)
         self._calls = 0
 
@@ -189,6 +214,7 @@ class SessionStore:
             "serve_batch_calls": 0,
             "serve_quarantines": 0,
             "serve_sessions_live": 0,
+            "serve_capacity_rejections": 0,
         }
 
         # ---- warmup: one call per program, so the warm path never
@@ -222,6 +248,32 @@ class SessionStore:
     def _callk(self, slots):
         return self._ck(self._store, slots, self._next_key())
 
+    def _served(self, call):
+        """Run one compiled serve call and hand back host-side outputs.
+        With `trace` on, additionally stamp the call's phase
+        boundaries into `last_spans`: `dispatch` (the compiled call is
+        issued), `device_compute` (its outputs are ready),
+        `scatter_back` (the host holds concrete values). The off path
+        is byte-identical to the uninstrumented round-13 behavior."""
+        if not self.trace:
+            # stale spans from a previously-traced window must never
+            # merge into a later request's trace
+            self.last_spans = None
+            self._store, out = call()
+            return jax.device_get(out)
+        t_dispatch = time.perf_counter()
+        self._store, out = call()
+        jax.block_until_ready(out)
+        t_compute = time.perf_counter()
+        out = jax.device_get(out)
+        t_scatter = time.perf_counter()
+        self.last_spans = {
+            "dispatch": t_dispatch,
+            "device_compute": t_compute,
+            "scatter_back": t_scatter,
+        }
+        return out
+
     # -- session lifecycle -------------------------------------------------
 
     def create(self, seed: int | None = None) -> int:
@@ -229,6 +281,9 @@ class SessionStore:
         id. Raises `RuntimeError` when the store is full."""
         free = np.flatnonzero(~self._live & ~self._quarantined)
         if free.size == 0:
+            self.stats["serve_capacity_rejections"] += 1
+            if self.metrics is not None:
+                self.metrics.counter("serve_capacity_rejections")
             raise RuntimeError(
                 f"session store full ({self.capacity} slots live or "
                 "quarantined); close sessions first"
@@ -267,6 +322,8 @@ class SessionStore:
             return
         self._quarantined[sid] = True
         self.stats["serve_quarantines"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("serve_quarantines")
         if self._runlog is not None:
             self._runlog.health(
                 mask, session_id=sid, action="quarantine",
@@ -278,10 +335,10 @@ class SessionStore:
     def decide(self, sid: int) -> ServeResult:
         """One policy decision on the unbatched AOT path."""
         self._check_sid(sid)
-        self._store, out = self._call1(
+        out = self._served(lambda: self._call1(
             _i32(sid), _i32(-1), _i32(0), jnp.bool_(False)
-        )
-        res = ServeResult(sid, jax.device_get(out), None, batched=False)
+        ))
+        res = ServeResult(sid, out, None, batched=False)
         self._apply_health(sid, res.health_mask)
         self.stats["serve_decisions"] += 1
         return res
@@ -291,11 +348,11 @@ class SessionStore:
         """Apply a CALLER-chosen action (same compiled program; the
         policy's pick is overridden by the forced-action select)."""
         self._check_sid(sid)
-        self._store, out = self._call1(
+        out = self._served(lambda: self._call1(
             _i32(sid), _i32(stage_idx), _i32(num_exec),
             jnp.bool_(True),
-        )
-        res = ServeResult(sid, jax.device_get(out), None, batched=False)
+        ))
+        res = ServeResult(sid, out, None, batched=False)
         self._apply_health(sid, res.health_mask)
         self.stats["serve_decisions"] += 1
         return res
@@ -318,8 +375,7 @@ class SessionStore:
             return [self.decide(sids[0])]
         slots = np.full(self.max_batch, self.capacity, np.int32)
         slots[: len(sids)] = sids
-        self._store, out = self._callk(jnp.asarray(slots))
-        out = jax.device_get(out)
+        out = self._served(lambda: self._callk(jnp.asarray(slots)))
         results = []
         for i, sid in enumerate(sids):
             res = ServeResult(sid, out, i, batched=True)
@@ -350,15 +406,22 @@ class Ticket:
     """One pending micro-batch request. At flush either `result` is
     set, or `error` holds the per-request failure (a quarantined or
     closed session fails ITS ticket only — co-batched requests are
-    still served)."""
+    still served). Under an instrumented front, `trace` carries the
+    request's `RequestTrace` (the trace id is minted HERE, at request
+    creation, so every later span hangs off one id)."""
 
-    __slots__ = ("session_id", "submitted_at", "result", "error")
+    __slots__ = ("session_id", "submitted_at", "result", "error",
+                 "trace")
 
-    def __init__(self, session_id: int) -> None:
+    def __init__(self, session_id: int, traced: bool = False) -> None:
         self.session_id = session_id
         self.submitted_at = time.perf_counter()
         self.result: ServeResult | None = None
         self.error: Exception | None = None
+        self.trace: RequestTrace | None = None
+        if traced:
+            self.trace = RequestTrace()
+            self.trace.stamp("submit", self.submitted_at)
 
     @property
     def ready(self) -> bool:
@@ -373,20 +436,39 @@ class MicroBatcher:
     request has waited `linger_ms` (the bounded linger window — the
     worst case a request can be delayed in exchange for batching);
     `flush()` forces. A lone pending request always takes the
-    unbatched AOT path (SessionStore.decide_batch's fallback)."""
+    unbatched AOT path (SessionStore.decide_batch's fallback).
 
-    def __init__(self, store: SessionStore, linger_ms: float = 1.0
+    Instrumentation (ISSUE 11, off by default): `metrics` receives
+    queue depth at flush, batch occupancy (K-fill), per-request linger
+    waits, flush-reason counters (`serve_flush_size|linger|forced`)
+    and per-span latency histograms; `trace=True` mints a
+    `RequestTrace` per ticket and — when `runlog` is given — emits one
+    runlog `trace` record per served request, with the store-level
+    device spans merged in when the store also has `trace` on."""
+
+    def __init__(self, store: SessionStore, linger_ms: float = 1.0,
+                 *, metrics=None, runlog=None, trace: bool = False
                  ) -> None:
         self.store = store
         self.linger_s = float(linger_ms) / 1e3
+        self.metrics = metrics
+        self.runlog = runlog
+        self.trace = bool(trace)
         self._pending: list[Ticket] = []
 
     def submit(self, sid: int) -> Ticket:
-        t = Ticket(sid)
+        t = Ticket(sid, traced=self.trace)
         self._pending.append(t)
         if len(self._pending) >= self.store.max_batch:
-            self.flush()
+            self.flush(reason="size")
         return t
+
+    @property
+    def pending(self) -> int:
+        """Requests queued but not yet flushed — the public view
+        drivers (serve/loadgen.py) use to decide an end-of-schedule
+        drain, so they never couple to the queue's representation."""
+        return len(self._pending)
 
     def poll(self) -> bool:
         """Flush if the linger window expired; True when a flush ran."""
@@ -394,11 +476,46 @@ class MicroBatcher:
             return False
         waited = time.perf_counter() - self._pending[0].submitted_at
         if waited >= self.linger_s:
-            self.flush()
+            self.flush(reason="linger")
             return True
         return False
 
-    def flush(self) -> None:
+    def _finish(self, t: Ticket) -> None:
+        """Resolve one ticket's instrumentation: merge the store's
+        device spans, stamp `reply`, emit the runlog `trace` record,
+        and feed the per-span histograms."""
+        m = self.metrics
+        if m is not None:
+            m.counter("serve_requests_total")
+            if t.error is not None:
+                m.counter("serve_request_errors")
+        if t.trace is None:
+            return
+        spans = self.store.last_spans
+        if t.error is None and spans is not None:
+            t.trace.spans.update(spans)
+        t.trace.stamp("reply")
+        if m is not None:
+            s = t.trace.spans
+            segs = (
+                ("serve_span_queue_ms", "submit", "batch_admit"),
+                ("serve_span_device_ms", "dispatch", "device_compute"),
+                ("serve_span_scatter_ms", "device_compute",
+                 "scatter_back"),
+                ("serve_span_total_ms", "submit", "reply"),
+            )
+            for name, a, b in segs:
+                if a in s and b in s:
+                    m.observe(name, (s[b] - s[a]) * 1e3)
+        if self.runlog is not None:
+            self.runlog.trace(
+                t.trace.trace_id, t.trace.offsets_ms(),
+                session_id=t.session_id,
+                error=None if t.error is None
+                else type(t.error).__name__,
+            )
+
+    def flush(self, reason: str = "forced") -> None:
         """Serve every pending ticket. Duplicate session ids in one
         window ride SUCCESSIVE batch calls (one session id per batch —
         decide_batch rejects duplicates, and two decisions for one
@@ -406,7 +523,17 @@ class MicroBatcher:
         be served (quarantined / closed session) fails its OWN ticket
         via `Ticket.error`; the rest of the batch is still served —
         no ticket is ever left unresolved."""
+        m = self.metrics
+        first = True
         while self._pending:
+            if m is not None:
+                # the flush reason counts ONCE per flush event; the
+                # admission views count per batch call so successive
+                # duplicate-draining batches stay visible
+                if first:
+                    m.counter(f"serve_flush_{reason}")
+                m.observe("serve_queue_depth", len(self._pending))
+            first = False
             batch: list[Ticket] = []
             seen: set[int] = set()
             rest: list[Ticket] = []
@@ -418,10 +545,27 @@ class MicroBatcher:
                 else:
                     rest.append(t)
             self._pending = rest  # each pass consumes >= 1 ticket
+            now = time.perf_counter()
+            for t in batch:
+                if m is not None:
+                    m.observe(
+                        "serve_linger_wait_ms",
+                        (now - t.submitted_at) * 1e3,
+                    )
+                if t.trace is not None:
+                    t.trace.stamp("batch_admit", now)
+            if m is not None:
+                m.observe("serve_batch_occupancy", len(batch))
             try:
-                results = self.store.decide_batch(
-                    [t.session_id for t in batch]
-                )
+                if self.trace:
+                    with annotate("serve/flush"):
+                        results = self.store.decide_batch(
+                            [t.session_id for t in batch]
+                        )
+                else:
+                    results = self.store.decide_batch(
+                        [t.session_id for t in batch]
+                    )
             except Exception:
                 # a bad session id poisons the whole batch call;
                 # re-serve one by one so only the offender fails
@@ -430,9 +574,11 @@ class MicroBatcher:
                         t.result = self.store.decide(t.session_id)
                     except Exception as e:
                         t.error = e
+                    self._finish(t)
                 continue
             for t, r in zip(batch, results):
                 t.result = r
+                self._finish(t)
 
 
 def store_from_config(
@@ -460,6 +606,15 @@ def store_from_config(
         "deterministic": bool(cfg.get("deterministic", True)),
         "donate": bool(cfg.get("donate", True)),
         "seed": int(cfg.get("seed", 0)),
+        # ISSUE 11 instrumentation keys: `trace: true` turns on the
+        # per-call span stamps; `metrics: true` attaches a fresh
+        # MetricsRegistry (callers needing a shared registry pass one
+        # via overrides)
+        "trace": bool(cfg.get("trace", False)),
     }
+    if cfg.get("metrics", False):
+        from ..obs.metrics import MetricsRegistry
+
+        kw["metrics"] = MetricsRegistry()
     kw.update(overrides)
     return SessionStore(params, bank, scheduler, **kw)

@@ -51,7 +51,12 @@ import optax
 from flax import serialization, struct
 
 from .. import metrics
-from ..config import HEALTH_KEYS, EnvParams, env_params_from_cfg
+from ..config import (
+    HEALTH_KEYS,
+    OBS_KEYS,
+    EnvParams,
+    env_params_from_cfg,
+)
 from ..env import core
 from ..env.health import (
     H_OOM,
@@ -210,8 +215,18 @@ class Trainer(abc.ABC):
         #     trace of (absolute) iteration N's collect+update
         #   trace_dir: where that trace lands (default
         #     artifacts/trace)
+        #   runlog_max_bytes: N — size-cap + numbered-suffix rotation
+        #     of the runlog file (ISSUE 11; 0/absent = unbounded)
         oc = dict(obs_cfg or {})
+        if set(oc) - OBS_KEYS:
+            raise ValueError(
+                "unknown obs: config key(s) "
+                f"{sorted(set(oc) - OBS_KEYS)} — known keys: "
+                f"{sorted(OBS_KEYS)}"
+            )
         self.obs_runlog = oc.get("runlog", True)
+        rmb = oc.get("runlog_max_bytes")
+        self.obs_runlog_max_bytes = int(rmb) if rmb else None
         self.obs_telemetry: bool = bool(oc.get("telemetry", False))
         self.obs_memory: bool = bool(oc.get("memory", True))
         ti = oc.get("trace_iteration")
@@ -919,9 +934,15 @@ class Trainer(abc.ABC):
         os.makedirs(self.checkpointing_dir, exist_ok=True)
         if self.obs_runlog and self._runlog is None:
             if isinstance(self.obs_runlog, str):
-                self._runlog = RunLog(self.obs_runlog)
+                self._runlog = RunLog(
+                    self.obs_runlog,
+                    max_bytes=self.obs_runlog_max_bytes,
+                )
             else:
-                self._runlog = RunLog.create(self.artifacts_dir)
+                self._runlog = RunLog.create(
+                    self.artifacts_dir,
+                    max_bytes=self.obs_runlog_max_bytes,
+                )
             self._runlog.install_jit_hooks()
             self._runlog.write(
                 "run_start",

@@ -1,9 +1,10 @@
 """Observability subsystem (sparksched_tpu/obs): runlog JSONL schema
-(incl. the `memory` records and crash-safe teardown), telemetry
-summaries, trace-annotation and profiler hygiene, and the TensorBoard
-fallback. (The no-bare-print lint that used to live here is now the
-analyzer's `bare-print` rule — sparksched_tpu/analysis/lint.py, run by
-tests/test_static_analysis.py.)"""
+(incl. the `memory`/`trace`/`metrics` records, size-based rotation and
+crash-safe teardown), the streaming-histogram metrics layer (ISSUE 11),
+telemetry summaries, trace-annotation and profiler hygiene, and the
+TensorBoard fallback. (The no-bare-print lint that used to live here is
+now the analyzer's `bare-print` rule — sparksched_tpu/analysis/lint.py,
+run by tests/test_static_analysis.py.)"""
 
 from __future__ import annotations
 
@@ -79,6 +80,102 @@ def test_masked_percentiles_all_false_mask():
         np.zeros((4, 3)), np.zeros((4, 3), dtype=bool)
     )
     np.testing.assert_array_equal(out2, np.zeros(len(PERCENTILE_QS)))
+
+
+# ---------------------------------------------------------------------------
+# streaming metrics (ISSUE 11): log-bucketed histogram quantiles,
+# merge, the counter/gauge/hist registry and its two exporters
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_histogram_quantiles_merge_and_bounds():
+    from sparksched_tpu.obs.metrics import StreamingHistogram
+
+    rng = np.random.default_rng(0)
+    xs = rng.lognormal(2.0, 1.0, 20_000)
+    h = StreamingHistogram()
+    h.add_many(xs)
+    # the whole point: quantiles within the documented relative error
+    # (half a bucket = sqrt(growth)-1) without retaining any samples
+    bound = h.summary()["scheme"]["max_rel_err"] + 0.01
+    for q in (0.5, 0.9, 0.99, 0.999):
+        exact = float(np.percentile(xs, q * 100))
+        assert abs(h.quantile(q) - exact) / exact < bound, q
+    assert h.count == xs.size
+    np.testing.assert_allclose(h.mean, xs.mean(), rtol=1e-9)
+    assert h.min == xs.min() and h.max == xs.max()
+    # mergeability: two halves == the whole, bucket-exact
+    a, b = StreamingHistogram(), StreamingHistogram()
+    a.add_many(xs[:7000])
+    b.add_many(xs[7000:])
+    a.merge(b)
+    assert a.counts == h.counts and a.count == h.count
+    # geometry mismatch must fail loudly, not shift quantiles
+    with pytest.raises(ValueError, match="geometry"):
+        a.merge(StreamingHistogram(growth=1.5))
+    # under/overflow land in the clamp buckets, quantiles stay in range
+    e = StreamingHistogram(lo=1.0, hi=10.0)
+    e.add_many([0.0, 0.5, 100.0, 2.0])
+    assert e.count == 4
+    assert e.quantile(0.999) <= 100.0
+
+
+def test_metrics_registry_snapshot_prometheus_and_merge():
+    import json
+
+    from sparksched_tpu.obs.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    m.counter("serve_flush_size")
+    m.counter("serve_flush_size")
+    m.counter("serve_flush_linger")
+    m.gauge("sessions_live", 5)
+    for v in (1.0, 2.0, 4.0):
+        m.observe("serve_queue_depth", v)
+    snap = m.snapshot()
+    json.dumps(snap)  # JSON-safe by contract (the JSONL exporter)
+    assert snap["counters"]["serve_flush_size"] == 2
+    assert snap["hists"]["serve_queue_depth"]["count"] == 3
+    txt = m.to_prometheus()
+    assert "# TYPE serve_flush_size counter" in txt
+    assert "serve_flush_size 2" in txt
+    assert "sessions_live 5" in txt
+    # histogram exposition: cumulative buckets ending in +Inf, _sum,
+    # _count — monotone by construction
+    assert 'serve_queue_depth_bucket{le="+Inf"} 3' in txt
+    assert "serve_queue_depth_sum 7" in txt
+    cums = [
+        int(ln.rsplit(" ", 1)[1]) for ln in txt.splitlines()
+        if ln.startswith("serve_queue_depth_bucket")
+    ]
+    assert cums == sorted(cums)
+    # cross-worker merge: counters add, hists merge
+    m2 = MetricsRegistry()
+    m2.counter("serve_flush_size", 3)
+    m2.observe("serve_queue_depth", 8.0)
+    m.merge(m2)
+    assert m.counters["serve_flush_size"] == 5
+    assert m.hists["serve_queue_depth"].count == 4
+
+
+def test_percentile_block_matches_legacy_and_hist_companion():
+    """The shared helper IS the r10 latency-row block: identical keys
+    and values to the pre-refactor numpy computation, so r10/r11
+    artifacts stay comparable; `hist_summary` is the O(buckets)
+    companion whose quantiles agree within the documented error."""
+    from sparksched_tpu.obs.metrics import hist_summary, percentile_block
+
+    samples = list(np.random.default_rng(3).lognormal(1.0, 0.8, 500))
+    block = percentile_block(samples, reps=500)
+    assert set(block) == {
+        "p50_ms", "p90_ms", "p99_ms", "mean_ms", "max_ms", "reps",
+    }
+    a = np.asarray(samples)
+    assert block["p50_ms"] == round(float(np.percentile(a, 50)), 4)
+    assert block["p99_ms"] == round(float(np.percentile(a, 99)), 4)
+    hb = hist_summary(samples)
+    bound = hb["scheme"]["max_rel_err"] + 0.01
+    assert abs(hb["p50_ms"] - block["p50_ms"]) / block["p50_ms"] < bound
 
 
 # ---------------------------------------------------------------------------
@@ -249,6 +346,94 @@ def test_runlog_memory_record_schema(tmp_path):
     assert mems[1]["phase"] == "bench_warmup"
 
 
+def test_runlog_trace_and_metrics_records(tmp_path):
+    """ISSUE 11: the `trace` record kind (per-request span offsets in
+    ms from submit, `total_ms` stamped from reply) and the `metrics`
+    record kind (a MetricsRegistry snapshot nested under `snapshot`)."""
+    from sparksched_tpu.obs import MetricsRegistry, RunLog
+
+    rl = RunLog(str(tmp_path / "t.jsonl"))
+    rl.trace(
+        "t1-00000001",
+        {"submit": 0.0, "batch_admit": 1.5, "dispatch": 1.6,
+         "device_compute": 9.0, "scatter_back": 9.4, "reply": 9.5},
+        session_id=3, error=None,
+    )
+    m = MetricsRegistry()
+    m.counter("serve_flush_size")
+    rl.metrics(m.snapshot(), iteration=4)
+    rl.close()
+    recs = [json.loads(ln) for ln in open(rl.path)]
+    tr = [r for r in recs if r["ev"] == "trace"][0]
+    assert tr["trace_id"] == "t1-00000001" and tr["session_id"] == 3
+    assert tr["spans"]["device_compute"] == 9.0
+    assert tr["total_ms"] == 9.5
+    mt = [r for r in recs if r["ev"] == "metrics"][0]
+    assert mt["snapshot"]["counters"]["serve_flush_size"] == 1
+    assert mt["iteration"] == 4
+
+
+# ---------------------------------------------------------------------------
+# runlog size-based rotation (ISSUE 11 satellite): long open-loop runs
+# must never grow one unbounded JSONL, and the crash-safety guarantees
+# must hold across rotation
+# ---------------------------------------------------------------------------
+
+
+def test_runlog_rotation_caps_active_file(tmp_path):
+    from sparksched_tpu.obs import RunLog
+
+    path = str(tmp_path / "r.jsonl")
+    rl = RunLog(path, max_bytes=600)
+    for i in range(200):
+        rl.write("tick", i=i, pad="x" * 40)
+    rl.close()
+    segs = sorted(
+        tmp_path.glob("r.jsonl.*"),
+        key=lambda p: int(p.suffix[1:]),
+    )
+    assert len(segs) >= 3, "rotation never fired"
+    # every segment AND the active file are complete valid JSONL
+    all_ticks = []
+    for p in [*segs, tmp_path / "r.jsonl"]:
+        for ln in open(p):
+            rec = json.loads(ln)  # every line parses
+            if rec["ev"] == "tick":
+                all_ticks.append(rec["i"])
+        assert os.path.getsize(p) <= 600 + 200  # cap + one record slop
+    assert all_ticks == list(range(200)), "rotation lost records"
+    # rotated segments are immutable history; the ACTIVE file carries
+    # the run_end and a `rotate` continuation marker at its head
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["ev"] == "rotate"
+    assert recs[0]["segment"] == len(segs)
+    assert recs[-1]["ev"] == "run_end"
+
+
+def test_runlog_rotation_numbering_survives_restart(tmp_path):
+    """A second run appending to the same path must continue the
+    numbered-suffix sequence, not clobber the first run's segments."""
+    from sparksched_tpu.obs import RunLog
+
+    path = str(tmp_path / "s.jsonl")
+    rl = RunLog(path, max_bytes=300)
+    for i in range(40):
+        rl.write("tick", run=1, i=i, pad="y" * 30)
+    rl.close()
+    first_segs = {p.name for p in tmp_path.glob("s.jsonl.*")}
+    assert first_segs
+    rl = RunLog(path, max_bytes=300)
+    for i in range(40):
+        rl.write("tick", run=2, i=i, pad="y" * 30)
+    rl.close()
+    for name in first_segs:
+        recs = [json.loads(ln) for ln in open(tmp_path / name)]
+        assert all(
+            r.get("run", 1) == 1 for r in recs if r["ev"] == "tick"
+        ), f"restart clobbered segment {name}"
+    assert len(list(tmp_path.glob("s.jsonl.*"))) > len(first_segs)
+
+
 def test_runlog_latency_record_and_serve_scalars(tmp_path):
     """ISSUE 10: the `latency` record kind (serving-path percentile
     samples, keys top-level and greppable like `memory`) and the
@@ -305,13 +490,14 @@ _KILLED_RUN = textwrap.dedent("""\
     import sys, time
     from sparksched_tpu.obs import RunLog
 
-    rl = RunLog(sys.argv[1])
+    mb = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    rl = RunLog(sys.argv[1], max_bytes=mb or None)
     rl.write("run_start", demo="kill")
     for i in range(10_000):
-        rl.write("tick", i=i)
-        if i == 3:
+        rl.write("tick", i=i, pad="z" * 40)
+        if i == 30:
             print("READY", flush=True)
-        time.sleep(0.05)
+        time.sleep(0.002)
 """)
 
 
@@ -341,6 +527,44 @@ def test_sigterm_killed_run_leaves_parseable_runlog(tmp_path):
     assert recs[-1]["teardown"] == "sigterm"
 
 
+def test_sigterm_killed_rotating_run_keeps_guarantees(tmp_path):
+    """Crash-safety ACROSS rotation (ISSUE 11 satellite): a SIGTERMed
+    run with a size cap leaves every rotated segment complete and
+    parseable, and the teardown run_end stamped in the ACTIVE file —
+    the same guarantees the uncapped runlog pins."""
+    path = str(tmp_path / "killed_rot.jsonl")
+    env = os.environ | {"JAX_PLATFORMS": "cpu"}
+    import pathlib
+
+    p = subprocess.Popen(
+        [sys.executable, "-c", _KILLED_RUN, path, "500"],
+        env=env, stdout=subprocess.PIPE, text=True,
+        cwd=pathlib.Path(__file__).resolve().parent.parent,
+    )
+    try:
+        assert p.stdout.readline().strip() == "READY"
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=60)
+    finally:
+        p.kill()
+    assert rc == -signal.SIGTERM
+    segs = sorted(
+        tmp_path.glob("killed_rot.jsonl.*"),
+        key=lambda q: int(q.suffix[1:]),
+    )
+    assert segs, "the capped run never rotated before the kill"
+    ticks = []
+    for q in [*segs, tmp_path / "killed_rot.jsonl"]:
+        for ln in open(q):
+            rec = json.loads(ln)  # every line of every segment parses
+            if rec["ev"] == "tick":
+                ticks.append(rec["i"])
+    assert ticks == list(range(len(ticks))), "rotation lost a tick"
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[-1]["ev"] == "run_end"
+    assert recs[-1]["teardown"] == "sigterm"
+
+
 def test_sigterm_teardown_never_blocks_on_held_lock(tmp_path):
     """The signal-path close must not block on the writer lock: a
     SIGTERM handler runs on the main thread possibly INSIDE a write()
@@ -363,6 +587,25 @@ def test_sigterm_teardown_never_blocks_on_held_lock(tmp_path):
     recs = [json.loads(ln) for ln in open(rl.path)]
     assert recs[-1] == recs[-1] | {"ev": "run_end",
                                    "teardown": "sigterm"}
+
+
+def test_obs_config_keys_validated_and_rotation_threaded(tmp_path):
+    """The obs: block fails loudly on unknown keys (the health:/serve:
+    contract, ISSUE 11) and `runlog_max_bytes` reaches the trainer's
+    RunLog as a live rotation cap."""
+    from sparksched_tpu.trainers import make_trainer
+
+    with pytest.raises(ValueError, match="unknown obs"):
+        cfg = _tiny_cfg(tmp_path)
+        cfg["obs"] = {"runlog": True, "telemetri": True}  # typo'd knob
+        make_trainer(cfg)
+    cfg = _tiny_cfg(tmp_path)
+    cfg["obs"]["runlog_max_bytes"] = 4096
+    t = make_trainer(cfg)
+    t._setup(fresh=True)
+    assert t._runlog.max_bytes == 4096
+    t._runlog.close()
+    t._runlog = None
 
 
 def test_trainer_stamps_memory_records(tmp_path, monkeypatch):

@@ -256,6 +256,75 @@ def test_batcher_flushes_on_full_batch_and_linger(store):
         store.close(s)
 
 
+def test_batcher_duplicate_ids_take_successive_batch_calls(store):
+    """ISSUE 11 satellite (the untested flush path): duplicate session
+    ids within one linger window must NOT share a batch call — the
+    first flush pass serves the de-duplicated set in ONE batch, each
+    remaining duplicate drains through a successive pass (a lone
+    leftover takes the unbatched fallback), and every ticket resolves
+    with its decisions in submission order."""
+    a = store.create(seed=300)
+    b = store.create(seed=301)
+    c = store.create(seed=302)
+    mb = MicroBatcher(store, linger_ms=1e6)
+    batch_before = store.stats["serve_batch_calls"]
+    dec_before = store.stats["serve_decisions"]
+    # [a, b, a]: the third submit reaches max_batch (3) and flushes —
+    # the de-dup pass serves [a, b] in one batch, then the leftover [a]
+    t1, t2 = mb.submit(a), mb.submit(b)
+    assert not (t1.ready or t2.ready)
+    t3 = mb.submit(a)
+    assert t1.ready and t2.ready and t3.ready
+    assert all(t.error is None for t in (t1, t2, t3))
+    assert not mb._pending, "flush left a ticket pending"
+    # one true batch call ([a, b]); the leftover [a] rode the
+    # unbatched fallback; three decisions total
+    assert store.stats["serve_batch_calls"] == batch_before + 1
+    assert store.stats["serve_decisions"] == dec_before + 3
+    assert t1.result.batched and t2.result.batched
+    assert not t3.result.batched
+    # two decisions for one session are sequential by definition
+    assert t3.result.wall_time >= t1.result.wall_time
+    for s in (a, b, c):
+        store.close(s)
+
+
+def test_batcher_exception_reserve_fallback_serves_survivors(store):
+    """ISSUE 11 satellite (the untested exception re-serve path): when
+    the BATCH call raises — a quarantined co-rider, a closed session —
+    flush re-serves the batch one by one so only the offending
+    ticket(s) carry errors; healthy tickets get real decisions and no
+    ticket is ever left unresolved."""
+    a = store.create(seed=310)
+    bad = store.create(seed=311)
+    gone = store.create(seed=312)
+    # quarantine `bad` via the ISSUE-9 sentinel (NaN in its slot's
+    # persistent clock), exactly as a poisoned device buffer would
+    env = store._store.env
+    store._store = store._store.replace(
+        env=env.replace(
+            job_t_completed=env.job_t_completed.at[bad].set(jnp.nan)
+        )
+    )
+    r = store.decide(bad)
+    assert r.health_mask != 0
+    store.close(gone)  # `gone` is now unknown to the store
+
+    mb = MicroBatcher(store, linger_ms=1e6)
+    ta, tb, tg = mb.submit(a), mb.submit(bad), mb.submit(gone)
+    # 3 pending == max_batch: auto-flush; decide_batch([a,bad,gone])
+    # raises, the fallback serves each alone
+    assert ta.ready and tb.ready and tg.ready
+    assert not mb._pending
+    assert ta.error is None and ta.result.decided
+    assert not ta.result.batched  # served by the fallback decide
+    assert isinstance(tb.error, SessionQuarantined)
+    assert isinstance(tg.error, SessionError)
+    assert tb.result is None and tg.result is None
+    store.close(bad)
+    store.close(a)
+
+
 def test_batcher_duplicates_and_failures_resolve_every_ticket(store):
     """A duplicate session id in one linger window rides a SUCCESSIVE
     batch call (two decisions for one session are sequential by
@@ -280,11 +349,175 @@ def test_batcher_duplicates_and_failures_resolve_every_ticket(store):
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 11: serving observability — admission/occupancy metrics,
+# per-request span traces, and the open-loop load generator
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_metrics_reasons_occupancy_and_queue(store):
+    from sparksched_tpu.obs.metrics import MetricsRegistry
+
+    sids = [store.create(seed=400 + i) for i in range(3)]
+    reg = MetricsRegistry()
+    store.metrics = reg
+    try:
+        mb = MicroBatcher(store, linger_ms=1e6, metrics=reg)
+        for s in sids:  # third submit reaches max_batch: size flush
+            mb.submit(s)
+        assert reg.counters["serve_flush_size"] == 1
+        assert reg.hists["serve_batch_occupancy"].max == 3.0
+        assert reg.hists["serve_queue_depth"].max == 3.0
+        assert reg.counters["serve_requests_total"] == 3
+
+        mb = MicroBatcher(store, linger_ms=0.0, metrics=reg)
+        mb.submit(sids[0])
+        assert mb.poll()  # expired window: linger flush
+        assert reg.counters["serve_flush_linger"] == 1
+        assert reg.hists["serve_linger_wait_ms"].count == 4
+
+        mb = MicroBatcher(store, linger_ms=1e6, metrics=reg)
+        mb.submit(sids[0])
+        mb.flush()  # explicit: forced
+        assert reg.counters["serve_flush_forced"] == 1
+        # one flush event != one batch call: the reason counts once,
+        # occupancy/queue-depth count per batch pass
+        assert reg.hists["serve_batch_occupancy"].count == 3
+    finally:
+        store.metrics = None
+        for s in sids:
+            store.close(s)
+
+
+def test_request_trace_spans_ordered_and_runlogged(store, tmp_path):
+    """The Dapper walk (ISSUE 11 tentpole): a trace id minted at
+    Ticket creation, span stamps monotone in submit -> batch_admit ->
+    dispatch -> device_compute -> scatter_back -> reply order, one
+    runlog `trace` record per request with offsets from submit."""
+    import json
+
+    from sparksched_tpu.obs.runlog import RunLog
+    from sparksched_tpu.obs.tracing import SPAN_ORDER
+
+    sids = [store.create(seed=420 + i) for i in range(3)]
+    rl = RunLog(str(tmp_path / "traces.jsonl"))
+    store.trace = True
+    try:
+        mb = MicroBatcher(store, linger_ms=1e6, runlog=rl, trace=True)
+        tks = [mb.submit(s) for s in sids]  # full batch: auto-flush
+        ids = set()
+        for tk in tks:
+            assert tk.ready and tk.error is None
+            spans = tk.trace.spans
+            assert set(SPAN_ORDER) <= set(spans)
+            stamps = [spans[k] for k in SPAN_ORDER]
+            assert stamps == sorted(stamps), "span order violated"
+            ids.add(tk.trace.trace_id)
+        assert len(ids) == 3, "trace ids must be unique per request"
+        rl.close()
+        recs = [json.loads(ln) for ln in open(rl.path)]
+        traces = [r for r in recs if r["ev"] == "trace"]
+        assert {r["trace_id"] for r in traces} == ids
+        for r in traces:
+            assert r["spans"]["submit"] == 0.0
+            assert r["total_ms"] == r["spans"]["reply"] >= 0.0
+            offs = [r["spans"][k] for k in SPAN_ORDER]
+            assert offs == sorted(offs)
+    finally:
+        store.trace = False
+        store.last_spans = None
+        for s in sids:
+            store.close(s)
+
+
+def test_instrumentation_off_leaves_request_path_bare(store):
+    """Zero-cost when off: an uninstrumented batcher mints no trace,
+    touches no registry, and the store stamps no spans — byte-for-byte
+    the round-13 request path."""
+    sid = store.create(seed=440)
+    mb = MicroBatcher(store, linger_ms=1e6)
+    tk = mb.submit(sid)
+    mb.flush()
+    assert tk.ready and tk.trace is None
+    assert store.last_spans is None
+    assert mb.metrics is None and mb.runlog is None
+    # turning trace off mid-life clears the stamps: stale spans from a
+    # traced window must never merge into a later request's trace
+    store.trace = True
+    store.decide(sid)
+    assert store.last_spans is not None
+    store.trace = False
+    store.decide(sid)
+    assert store.last_spans is None
+    store.close(sid)
+
+
+def test_loadgen_deterministic_schedules_and_rates():
+    import numpy as np
+
+    from sparksched_tpu.serve import generate_arrivals
+
+    a1 = generate_arrivals(100.0, 2000, 8, seed=3)
+    a2 = generate_arrivals(100.0, 2000, 8, seed=3)
+    assert a1 == a2, "seeded schedules must be byte-identical"
+    assert a1 != generate_arrivals(100.0, 2000, 8, seed=4)
+    times = np.array([t for t, _ in a1])
+    tenants = [w for _, w in a1]
+    assert (np.diff(times) >= 0).all()
+    assert set(tenants) <= set(range(8))
+    # long-run offered rate ~= requested (Poisson, n=2000: loose band)
+    assert abs(2000 / times[-1] - 100.0) < 15.0
+    # MMPP: same long-run mean rate, strictly burstier inter-arrivals
+    am = generate_arrivals(
+        100.0, 30_000, 8, process="mmpp", seed=3, burst_factor=8.0,
+        burst_fraction=0.1, burst_dwell_s=0.5,
+    )
+    tm = np.array([t for t, _ in am])
+    assert abs(30_000 / tm[-1] - 100.0) < 10.0
+    dp = np.diff(times)
+    dm = np.diff(tm)
+    cv2_poisson = dp.var() / dp.mean() ** 2  # ~1 by definition
+    cv2_mmpp = dm.var() / dm.mean() ** 2
+    assert cv2_mmpp > 1.5 > cv2_poisson * 1.2
+    with pytest.raises(ValueError, match="unknown arrival process"):
+        generate_arrivals(10.0, 5, 2, process="weibull")
+
+
+def test_run_open_loop_resolves_every_request(store):
+    """Open-loop smoke on the tiny store: every scheduled request is
+    submitted, served and accounted; the summary's counters, histogram
+    and goodput fields are consistent."""
+    from sparksched_tpu.obs.metrics import MetricsRegistry
+    from sparksched_tpu.serve import generate_arrivals, run_open_loop
+
+    arrivals = generate_arrivals(150.0, 24, 3, seed=7)
+    reg = MetricsRegistry()
+    store.metrics = reg
+    try:
+        mb = MicroBatcher(store, linger_ms=1.0, metrics=reg)
+        out = run_open_loop(
+            store, mb, arrivals, slo_ms=10_000.0, session_seed=30_000
+        )
+    finally:
+        store.metrics = None
+    assert out["requests"] == out["completed"] == 24
+    assert out["errors"] == 0
+    assert out["good"] == 24  # generous SLO: everything is goodput
+    assert out["hist"].count == 24
+    assert len(out["samples_ms"]) == 24
+    assert out["goodput_rps"] == out["achieved_rps"]
+    assert out["capacity_rejections"] == 0
+    assert reg.counters["serve_requests_total"] == 24
+    # the run closed its tenant sessions behind itself
+    assert store.stats["serve_sessions_live"] == 0
+
+
+# ---------------------------------------------------------------------------
 # serve: config block + bench row schema helpers
 # ---------------------------------------------------------------------------
 
 
 def test_store_from_config_rejects_unknown_keys(setup):
+    from sparksched_tpu.config import SERVE_KEYS
     from sparksched_tpu.serve import store_from_config
 
     params, bank, sched = setup
@@ -292,6 +525,9 @@ def test_store_from_config_rejects_unknown_keys(setup):
         store_from_config(
             {"capcity": 4}, params, bank, sched  # typo'd knob
         )
+    # the ISSUE-11 instrumentation keys are part of the declared
+    # surface (config.SERVE_KEYS is the single source of truth)
+    assert {"trace", "metrics"} <= SERVE_KEYS
 
 
 def test_latency_row_blocks():
