@@ -657,22 +657,30 @@ def _serve_obs_overhead(store, reps: int = 30) -> dict:
 
 
 def bench_serve_scale(
-    artifact: str = "artifacts/serve_scale_r11.json",
+    artifact: str = "artifacts/serve_scale_r13.json",
 ) -> list[dict]:
-    """Serving at load (ISSUE 11): open-loop offered-load sweep over
-    the AOT session store + micro-batching front, reporting GOODPUT
-    under a p99 SLO — replies within `slo_ms` of their SCHEDULED
-    arrival per second of run — and the p99-vs-offered-load curve.
-    One `serve_scale` JSON row per offered-load point (plus one bursty
-    MMPP row at the midpoint rate); every row carries the per-request
-    trace span summary and the admission/occupancy metrics (queue
-    depth, batch K-fill, linger waits, flush reasons, quarantines,
-    capacity rejections) from the instrumented front, and the full set
-    lands in `artifact` with the protocol + the instrumentation-
-    overhead A/B. Arrival schedules are seeded and deterministic
-    (serve/loadgen.py); latency is measured open-loop, so offered
-    loads beyond capacity show the queueing tail closed-loop medians
-    can never see."""
+    """Serving at load (ISSUE 11/13): open-loop offered-load sweep
+    over the AOT session store, reporting GOODPUT under a p99 SLO —
+    replies within `slo_ms` of their SCHEDULED arrival per second of
+    run — and the p99-vs-offered-load curve.
+
+    Since round 15 this is an A/B bench over the two batching fronts:
+    at every offered-load point the SAME seeded arrival schedule runs
+    through the fixed-linger `MicroBatcher` (the r10/r11 front) and
+    the `ContinuousBatcher` (ISSUE 13 — occupancy-driven, no linger
+    timer), arms interleaved rep-by-rep per point so box-level drift
+    hits both equally, medians compared (the PR-11 `interleaved_ab`
+    protocol at run granularity). Each (point, front) pair emits one
+    row — the median-goodput rep's full summary, with the per-rep
+    goodput/p99 lists in its `ab` block — and the artifact's protocol
+    carries the per-front SUSTAINED rate (the highest offered load
+    whose median p99 met the SLO): the headline the continuous
+    batcher exists to raise. Rows also stamp the hot-set capacity
+    advice (`SessionStore.hot_set_advice` — how many device slots the
+    HBM budget holds, the pager's sizing model). Arrival schedules
+    are seeded and deterministic (serve/loadgen.py); latency is
+    measured open-loop, so offered loads beyond capacity show the
+    queueing tail closed-loop medians can never see."""
     offered = [
         float(x) for x in os.environ.get(
             "SERVE_SCALE_OFFERED", "12.5,25,50,100,200"
@@ -683,9 +691,26 @@ def bench_serve_scale(
     slo_ms = float(os.environ.get("SERVE_SCALE_SLO_MS", 200))
     linger_ms = float(os.environ.get("SERVE_SCALE_LINGER_MS", 2))
     capacity = int(os.environ.get("SERVE_SCALE_CAPACITY", 32))
+    hot_env = os.environ.get("SERVE_SCALE_HOT_CAPACITY", "")
+    hot_capacity = int(hot_env) if hot_env else None
     max_batch = int(os.environ.get("SERVE_SCALE_BATCH", 8))
     with_mmpp = os.environ.get("SERVE_SCALE_MMPP", "1") == "1"
     seed = int(os.environ.get("SERVE_SCALE_SEED", 11))
+    fronts = [
+        f.strip() for f in os.environ.get(
+            "SERVE_SCALE_FRONTS", "linger,continuous"
+        ).split(",") if f.strip()
+    ]
+    unknown_fronts = set(fronts) - {"linger", "continuous"}
+    if unknown_fronts:
+        # fail loudly (the serve-config contract): a typo'd front
+        # would silently run the fallback arm twice and stamp the
+        # paired A/B rows with a label that never ran
+        raise ValueError(
+            f"unknown SERVE_SCALE_FRONTS entr(y/ies) "
+            f"{sorted(unknown_fronts)}; known: continuous, linger"
+        )
+    ab_reps = int(os.environ.get("SERVE_SCALE_AB_REPS", 3))
 
     from sparksched_tpu.obs.metrics import (
         MetricsRegistry,
@@ -694,6 +719,7 @@ def bench_serve_scale(
     )
     from sparksched_tpu.obs.runlog import RunLog
     from sparksched_tpu.serve import (
+        ContinuousBatcher,
         MicroBatcher,
         SessionStore,
         generate_arrivals,
@@ -704,13 +730,16 @@ def bench_serve_scale(
     runlog = RunLog.create("artifacts", name=None)
     t0 = time.perf_counter()
     store = SessionStore(
-        params, bank, sched, capacity=capacity, max_batch=max_batch,
+        params, bank, sched, capacity=capacity,
+        hot_capacity=hot_capacity, max_batch=max_batch,
         deterministic=True, seed=0, runlog=runlog,
     )
     cold_start_s = time.perf_counter() - t0
+    hot_set = store.hot_set_advice()
 
     base_cfg = {
         "capacity": capacity,
+        "hot_capacity": store.hot_capacity,
         "max_batch": max_batch,
         "linger_ms": linger_ms,
         "tenants": tenants,
@@ -728,98 +757,172 @@ def bench_serve_scale(
     points = [(r, "poisson") for r in offered]
     if with_mmpp and offered:
         points.append((offered[len(offered) // 2], "mmpp"))
+    # per-front median p99 at each poisson rate, for the sustained-
+    # under-SLO summary
+    p99_med: dict[tuple[str, float], float] = {}
 
-    for rate, process in points:
+    def one_run(rate, process, front):
+        """One open-loop run of the seeded schedule through `front`;
+        returns (summary, samples, hist, metrics snapshot)."""
         arrivals = generate_arrivals(
             rate, n_req, tenants, process=process, seed=seed
         )
         reg = MetricsRegistry()
         store.metrics, store.trace = reg, True
-        mb = MicroBatcher(
-            store, linger_ms=linger_ms, metrics=reg, runlog=runlog,
-            trace=True,
-        )
+        if front == "continuous":
+            b = ContinuousBatcher(
+                store, metrics=reg, runlog=runlog, trace=True
+            )
+        else:
+            b = MicroBatcher(
+                store, linger_ms=linger_ms, metrics=reg,
+                runlog=runlog, trace=True,
+            )
         summary = run_open_loop(
-            store, mb, arrivals, slo_ms=slo_ms,
+            store, b, arrivals, slo_ms=slo_ms,
             session_seed=20_000 + int(rate),
         )
         samples = summary.pop("samples_ms")
         hist = summary.pop("hist")
-        snap = reg.snapshot()
-        lat_block = percentile_block(samples)
-        p99 = lat_block["p99_ms"]
-        tag = "_mmpp" if process == "mmpp" else ""
-        row = {
-            "metric": f"serve_scale_offered{rate:g}rps{tag}",
-            # the headline value IS goodput: SLO-satisfying decisions/s
-            "value": summary["goodput_rps"],
-            "unit": "decisions/s",
-            "slo": {
-                "p99_slo_ms": slo_ms,
-                "p99_ms": p99,
-                "slo_met": p99 <= slo_ms,
-                "good": summary["good"],
-                "good_fraction": round(
-                    summary["good"] / max(summary["completed"], 1), 4
-                ),
-                "goodput_rps": summary["goodput_rps"],
-            },
-            "open_loop": {
-                k: summary[k] for k in (
-                    "requests", "completed", "errors", "makespan_s",
-                    "offered_rps", "achieved_rps", "session_rotations",
-                    "capacity_rejections",
-                )
-            },
-            "latency": lat_block | {"hist": hist_summary(hist)},
-            # the trace stamp: per-span latency summaries from the
-            # instrumented front (queue wait / device compute /
-            # scatter-back / total), one histogram each
-            "trace": {
-                k: v for k, v in snap["hists"].items()
-                if k.startswith("serve_span_")
-            },
-            # the metrics stamp: admission/occupancy views + counters
-            "metrics": {
-                "queue_depth": snap["hists"].get("serve_queue_depth"),
-                "batch_occupancy": snap["hists"].get(
-                    "serve_batch_occupancy"
-                ),
-                "linger_wait_ms": snap["hists"].get(
-                    "serve_linger_wait_ms"
-                ),
-                "flush_reasons": {
-                    k.removeprefix("serve_flush_"): int(v)
-                    for k, v in snap["counters"].items()
-                    if k.startswith("serve_flush_")
-                },
-                "quarantines": int(
-                    snap["counters"].get("serve_quarantines", 0)
-                ),
-                # store-side create() failures (one per rotation
-                # attempt) — request-level rejections live in
-                # open_loop.capacity_rejections; the two counters
-                # measure different events and are named apart
-                "store_create_rejections": int(
-                    snap["counters"].get(
-                        "serve_capacity_rejections", 0
-                    )
-                ),
-                "rejected_requests": int(
-                    snap["counters"].get("serve_requests_rejected", 0)
-                ),
-            },
-            "analysis_clean": analysis_clean_stamp(),
-            "config": base_cfg | {
-                "offered_rps": rate, "process": process,
-                "cold_start_s": round(cold_start_s, 3),
-            },
-            "on_chip": _on_chip_block(),
-        }
-        rows.append(row)
-        runlog.metrics(snap, metric=row["metric"])
-        print(json.dumps(row), flush=True)
+        return summary, samples, hist, reg.snapshot()
 
+    for rate, process in points:
+        # interleaved arms, rep-by-rep (the PR-11 interleaved_ab
+        # protocol at run granularity): linger rep 1, continuous rep
+        # 1, linger rep 2, ... so drift hits both fronts equally
+        runs: dict[str, list] = {f: [] for f in fronts}
+        for _rep in range(max(1, ab_reps)):
+            for front in fronts:
+                runs[front].append(one_run(rate, process, front))
+        tag = "_mmpp" if process == "mmpp" else ""
+        for front in fronts:
+            reps = runs[front]
+            goodputs = [r[0]["goodput_rps"] for r in reps]
+            p99s = [
+                percentile_block(r[1])["p99_ms"] for r in reps
+            ]
+            # the row is the MEDIAN-goodput rep's full summary
+            order = sorted(range(len(reps)), key=goodputs.__getitem__)
+            summary, samples, hist, snap = reps[order[len(order) // 2]]
+            lat_block = percentile_block(samples)
+            med_p99 = sorted(p99s)[len(p99s) // 2]
+            if process == "poisson":
+                p99_med[(front, rate)] = med_p99
+            # linger rows keep the r11 metric names (directly
+            # comparable at equal offered load); continuous adds _cb
+            suffix = "_cb" if front == "continuous" else ""
+            row = {
+                "metric": (
+                    f"serve_scale_offered{rate:g}rps{tag}{suffix}"
+                ),
+                # the headline value IS goodput: SLO-satisfying
+                # decisions/s (median rep)
+                "value": summary["goodput_rps"],
+                "unit": "decisions/s",
+                "slo": {
+                    "p99_slo_ms": slo_ms,
+                    "p99_ms": lat_block["p99_ms"],
+                    "p99_ms_median": med_p99,
+                    "slo_met": med_p99 <= slo_ms,
+                    "good": summary["good"],
+                    "good_fraction": round(
+                        summary["good"]
+                        / max(summary["completed"], 1), 4
+                    ),
+                    "goodput_rps": summary["goodput_rps"],
+                },
+                # the paired-A/B block: per-rep values for both the
+                # curve and the pairing key shared by the two fronts'
+                # rows at this point
+                "ab": {
+                    "pair": f"offered{rate:g}rps{tag}",
+                    "front": front,
+                    "reps": len(reps),
+                    "goodput_rps_reps": goodputs,
+                    "p99_ms_reps": p99s,
+                    "goodput_rps_median": sorted(goodputs)[
+                        len(goodputs) // 2
+                    ],
+                },
+                "open_loop": {
+                    k: summary[k] for k in (
+                        "requests", "front", "completed", "errors",
+                        "makespan_s", "offered_rps", "achieved_rps",
+                        "session_rotations", "capacity_rejections",
+                    )
+                },
+                "latency": lat_block | {"hist": hist_summary(hist)},
+                # the trace stamp: per-span latency summaries from
+                # the instrumented front (queue wait / device compute
+                # / scatter-back / total), one histogram each
+                "trace": {
+                    k: v for k, v in snap["hists"].items()
+                    if k.startswith("serve_span_")
+                },
+                # the metrics stamp: admission/occupancy views +
+                # counters (wait_ms is the linger wait under the
+                # linger front, the queue wait under continuous)
+                "metrics": {
+                    "queue_depth": snap["hists"].get(
+                        "serve_queue_depth"
+                    ),
+                    "batch_occupancy": snap["hists"].get(
+                        "serve_batch_occupancy"
+                    ),
+                    "wait_ms": snap["hists"].get(
+                        "serve_linger_wait_ms"
+                    ) or snap["hists"].get("serve_queue_wait_ms"),
+                    "flush_reasons": {
+                        k.removeprefix("serve_flush_"): int(v)
+                        for k, v in snap["counters"].items()
+                        if k.startswith("serve_flush_")
+                    },
+                    "quarantines": int(
+                        snap["counters"].get("serve_quarantines", 0)
+                    ),
+                    # store-side create() failures (one per rotation
+                    # attempt) — request-level rejections live in
+                    # open_loop.capacity_rejections; the two counters
+                    # measure different events and are named apart
+                    "store_create_rejections": int(
+                        snap["counters"].get(
+                            "serve_capacity_rejections", 0
+                        )
+                    ),
+                    "rejected_requests": int(
+                        snap["counters"].get(
+                            "serve_requests_rejected", 0
+                        )
+                    ),
+                    "page_ins": int(
+                        snap["counters"].get("serve_page_ins", 0)
+                    ),
+                    "page_outs": int(
+                        snap["counters"].get("serve_page_outs", 0)
+                    ),
+                },
+                "analysis_clean": analysis_clean_stamp(),
+                "config": base_cfg | {
+                    "offered_rps": rate, "process": process,
+                    "front": front,
+                    "cold_start_s": round(cold_start_s, 3),
+                },
+                "on_chip": _on_chip_block(),
+            }
+            rows.append(row)
+            runlog.metrics(snap, metric=row["metric"])
+            print(json.dumps(row), flush=True)
+
+    # the headline the A/B exists to measure: per front, the highest
+    # offered (poisson) load whose MEDIAN p99 met the SLO
+    sustained = {
+        front: max(
+            (r for r in offered
+             if p99_med.get((front, r), float("inf")) <= slo_ms),
+            default=0.0,
+        )
+        for front in fronts
+    }
     overhead = _serve_obs_overhead(store)
     os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
     with open(artifact, "w") as fp:
@@ -832,6 +935,16 @@ def bench_serve_scale(
                 "open_loop": "seeded deterministic arrival schedule "
                              "(serve/loadgen.py), never "
                              "back-pressured by response times",
+                "ab": "paired fronts at the SAME seeded schedule per "
+                      "point, arms interleaved rep-by-rep, medians "
+                      "compared (PR-11 interleaved_ab protocol at "
+                      "run granularity)",
+                "fronts": fronts,
+                "ab_reps": ab_reps,
+                "sustained_rps_slo": sustained,
+                # run-invariant store sizing (the pager's capacity
+                # model): stamped ONCE here, not per row
+                "hot_set": hot_set,
                 "arrival_processes": sorted({p for _, p in points}),
                 "requests_per_point": n_req,
                 "offered_sweep_rps": offered,
@@ -841,8 +954,9 @@ def bench_serve_scale(
         }, fp, indent=1)
     runlog.close()
     print(
-        f"# bench_decima: wrote {artifact} ({len(rows)} rows; obs "
-        f"overhead {overhead['overhead_pct']:+.2f}% "
+        f"# bench_decima: wrote {artifact} ({len(rows)} rows; "
+        f"sustained@SLO {sustained}; obs overhead "
+        f"{overhead['overhead_pct']:+.2f}% "
         f"{'PASS' if overhead['passed'] else 'FAIL'} vs 5% bar)",
         file=sys.stderr, flush=True,
     )

@@ -575,15 +575,19 @@ def stage_serve_scale():
     """ISSUE 11: on-chip open-loop goodput@SLO capture — the offered-
     load sweep through the seeded load generator + instrumented
     micro-batching front (`bench_decima.bench_serve_scale`), written
-    as `serve_scale` rows + artifacts/serve_scale_r11.json. Runs
-    ENTIRELY in a subprocess, gate included (counting devices claims
-    the client); a chipless host prints an explicit
-    `[serve-scale] UNAVAILABLE` marker and exits 0 — the watcher log
-    must distinguish "no window" from "never ran". The CPU sweep at
-    the default scale lives in PERF.md round 14; this stage is the
-    on-chip confirmation slot. Chip-scale knobs (more tenants, higher
-    offered loads, a tighter SLO — the chip's per-decision latency is
-    ~ms, not ~100 ms) default below; every one is env-overridable."""
+    as `serve_scale` rows + artifacts/serve_scale_chip.json (its own
+    path — it must never clobber the committed CPU artifacts). Since
+    round 15 the bench defaults to the paired-front A/B; this stage
+    pins the LINGER front at 1 rep to stay the r11-style single-front
+    capture (the paired chip A/B is stage 16's job). Runs ENTIRELY in
+    a subprocess, gate included (counting devices claims the client);
+    a chipless host prints an explicit `[serve-scale] UNAVAILABLE`
+    marker and exits 0 — the watcher log must distinguish "no window"
+    from "never ran". The CPU sweep at the default scale lives in
+    PERF.md round 14; this stage is the on-chip confirmation slot.
+    Chip-scale knobs (more tenants, higher offered loads, a tighter
+    SLO — the chip's per-decision latency is ~ms, not ~100 ms)
+    default below; every one is env-overridable."""
     import os
     import os.path as osp
     import subprocess
@@ -613,9 +617,19 @@ def stage_serve_scale():
         "flush=True)\n"
         "    sys.exit(0)\n"
         "import bench_decima\n"
-        "bench_decima.bench_serve_scale()\n"
+        "bench_decima.bench_serve_scale(\n"
+        "    artifact='artifacts/serve_scale_chip.json')\n"
     )
     env = os.environ | {
+        # r11-style single-front capture: the round-15 bench defaults
+        # to the 2-front x 3-rep A/B, which would burn ~6x the window
+        # AND duplicate stage 16; pin the linger arm at 1 rep here
+        "SERVE_SCALE_FRONTS": os.environ.get(
+            "SERVE_SCALE_FRONTS", "linger"
+        ),
+        "SERVE_SCALE_AB_REPS": os.environ.get(
+            "SERVE_SCALE_AB_REPS", "1"
+        ),
         # chip-scale open loop: 64 tenants on a 128-slot store, the
         # sweep pushed past the chip's serving capacity so the curve
         # shows the same knee the CPU round recorded
@@ -640,6 +654,88 @@ def stage_serve_scale():
         [sys.executable, "-c", code], cwd=repo, timeout=2700, env=env,
     )
     print(f"[serve-scale] subprocess rc={r.returncode}", flush=True)
+
+
+def stage_serve_cb():
+    """ISSUE 13: on-chip continuous-vs-linger batching A/B — the
+    paired-front offered-load sweep (`bench_decima.bench_serve_scale`,
+    round-15 protocol: same seeded schedule per point, arms
+    interleaved rep-by-rep, medians compared) against the chip-scale
+    session store, written as paired `serve_scale` rows +
+    artifacts/serve_cb_chip.json. Runs ENTIRELY in a subprocess, gate
+    included (counting devices claims the client); a chipless host
+    prints an explicit `[serve-cb] UNAVAILABLE` marker and exits 0 —
+    the watcher log must distinguish "no window" from "never ran".
+    The CPU A/B at the default scale lives in
+    artifacts/serve_scale_r13.json / PERF.md round 15; this stage is
+    the on-chip confirmation slot. Chip-scale knobs (hot-paged
+    128-slot store under a 256-session capacity, tighter SLO —
+    the chip's per-decision latency is ~ms) default below; every one
+    is env-overridable."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    if _client_held():
+        print("[serve-cb] parent process already holds a device "
+              "client; run stage 16 as its own invocation", flush=True)
+        return
+    repo = osp.dirname(osp.abspath(__file__))
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from sparksched_tpu.config import (\n"
+        "    enable_compilation_cache, honor_jax_platforms_env,\n"
+        "    use_fast_prng,\n"
+        ")\n"
+        "honor_jax_platforms_env()\n"
+        "enable_compilation_cache()\n"
+        "if os.environ.get('BENCH_PRNG', 'rbg') == 'rbg':\n"
+        "    use_fast_prng()\n"
+        "import jax\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    print('[serve-cb] UNAVAILABLE: cpu backend only; the "
+        "chip-scale continuous-vs-linger A/B rows need a chip window "
+        "(the CPU A/B is recorded in artifacts/serve_scale_r13.json "
+        "and PERF.md round 15)', flush=True)\n"
+        "    sys.exit(0)\n"
+        "import bench_decima\n"
+        "bench_decima.bench_serve_scale(\n"
+        "    artifact='artifacts/serve_cb_chip.json')\n"
+    )
+    env = os.environ | {
+        # chip-scale paired A/B: a host-paged 128-slot hot set under a
+        # 256-session capacity (the pager's first on-chip exercise),
+        # both fronts at every point, the sweep pushed past the chip's
+        # serving capacity so both knees are on the curve
+        "SERVE_SCALE_CAPACITY": os.environ.get(
+            "SERVE_SCALE_CAPACITY", "256"
+        ),
+        "SERVE_SCALE_HOT_CAPACITY": os.environ.get(
+            "SERVE_SCALE_HOT_CAPACITY", "128"
+        ),
+        "SERVE_SCALE_BATCH": os.environ.get("SERVE_SCALE_BATCH", "16"),
+        "SERVE_SCALE_TENANTS": os.environ.get(
+            "SERVE_SCALE_TENANTS", "64"
+        ),
+        "SERVE_SCALE_REQUESTS": os.environ.get(
+            "SERVE_SCALE_REQUESTS", "2000"
+        ),
+        "SERVE_SCALE_OFFERED": os.environ.get(
+            "SERVE_SCALE_OFFERED", "250,500,1000,2000,4000"
+        ),
+        "SERVE_SCALE_SLO_MS": os.environ.get(
+            "SERVE_SCALE_SLO_MS", "25"
+        ),
+        "SERVE_SCALE_AB_REPS": os.environ.get(
+            "SERVE_SCALE_AB_REPS", "3"
+        ),
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, timeout=3600, env=env,
+    )
+    print(f"[serve-cb] subprocess rc={r.returncode}", flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -718,6 +814,7 @@ STAGES = {
     "13": ("fused-engine headline bench", stage_fused_headline),
     "14": ("serving-latency capture", stage_serve_latency),
     "15": ("serve-scale open-loop capture", stage_serve_scale),
+    "16": ("continuous-batching A/B capture", stage_serve_cb),
 }
 
 
@@ -751,10 +848,10 @@ if __name__ == "__main__":
                 print("chip unavailable; aborting session", flush=True)
                 break
         finally:
-            # 7, 12, 13 and 14 run in subprocesses and 10 is
+            # 7, 12, 13, 14, 15 and 16 run in subprocesses and 10 is
             # CPU-subprocess-only: none takes the in-process device
             # client
-            if p not in ("7", "10", "12", "13", "14"):
+            if p not in ("7", "10", "12", "13", "14", "15", "16"):
                 _mark_client_held()
             if ledger_path:
                 ledger[p] = {

@@ -35,6 +35,8 @@ rm -f /tmp/fused_headline_done
 rm -f /tmp/serve_latency_done
 # ... and for the serve-scale open-loop capture (stage 15, ISSUE 11)
 rm -f /tmp/serve_scale_done
+# ... and for the continuous-batching A/B capture (stage 16, ISSUE 13)
+rm -f /tmp/serve_cb_done
 # stage-completion ledger (ISSUE 9): per-LIFETIME like the markers
 # above — a restarted watcher must re-run its multi-stage sessions, not
 # inherit a previous lifetime's completions (the ledger's job is
@@ -271,6 +273,22 @@ print('ALIVE')
       echo "serve-scale rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
       grep -q '"backend": "tpu"' /tmp/serve_scale_last.log \
         && touch "$SERVE_SCALE_MARK"
+    fi
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time continuous-batching A/B capture (ISSUE 13, stage 16):
+    # the paired continuous-vs-linger offered-load sweep against the
+    # chip-scale host-paged store — the on-chip partner of the CPU A/B
+    # in artifacts/serve_scale_r13.json / PERF.md round 15. Once per
+    # watcher lifetime; marked done only when a TPU-backed row landed
+    # (an UNAVAILABLE marker means no window yet — retry next loop,
+    # like the stage-13/14/15 slots).
+    SERVE_CB_MARK=/tmp/serve_cb_done
+    if [ ! -f "$SERVE_CB_MARK" ]; then
+      timeout -k 60 3700 python scripts_chip_session.py 16 \
+        | tee /tmp/serve_cb_last.log
+      echo "serve-cb rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q '"backend": "tpu"' /tmp/serve_cb_last.log \
+        && touch "$SERVE_CB_MARK"
     fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable

@@ -179,6 +179,19 @@ BUDGETS: dict[str, Budget] = {
     "serve_decide_batch": Budget(
         eqn_lo=6000, eqn_hi=17400, gather_hi=339, scatter_hi=88,
     ),
+    # ISSUE 13: the dp-sharded store variant (serve/aot.py
+    # `serve_decide_batch_fn(..., shard=...)`), pinned 2026-08-04 —
+    # 12975/251/65: exactly the unsharded batch program plus one
+    # sharding_constraint eqn per store leaf at entry and exit. The
+    # constraint count is MESH-SIZE-INVARIANT (the mesh is a lowering
+    # parameter, not an equation — measured identical at 1 and 8
+    # devices), so the pin holds on the 1-device analysis CLI and the
+    # 8-virtual-device test mesh alike; the unsharded programs above
+    # re-measured byte-identical, which is the acceptance bar (shard
+    # off must change nothing).
+    "serve_decide_batch_sharded": Budget(
+        eqn_lo=6000, eqn_hi=17500, gather_hi=339, scatter_hi=88,
+    ),
 }
 
 
@@ -529,12 +542,16 @@ def program_callables(names: tuple[str, ...] | None = None
                 lambda r, o: sched.batch_policy(r, o), (key, obs_b)
             )
 
-    if want is None or want & {"serve_decide", "serve_decide_batch"}:
-        # ISSUE 10: the AOT decision service's two programs (serving
+    if want is None or want & {
+        "serve_decide", "serve_decide_batch",
+        "serve_decide_batch_sharded",
+    }:
+        # ISSUE 10/13: the AOT decision service's programs (serving
         # store capacity 8, micro-batch width 4 at audit scale; the
-        # production programs differ only in buffer widths). Traced
-        # here exactly as `serve/aot.py` lowers them, so the audited
-        # jaxpr IS the compiled serving program.
+        # production programs differ only in buffer widths), plus the
+        # dp-sharded store variant. Traced here exactly as
+        # `serve/aot.py` lowers them, so the audited jaxpr IS the
+        # compiled serving program.
         from ..serve.aot import serve_callables
 
         for name, entry in serve_callables().items():

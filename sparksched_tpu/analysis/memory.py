@@ -148,6 +148,13 @@ MEM_BUDGETS: dict[str, MemBudget] = {
     # this band long before it shows up as a p99 regression on-chip.
     "serve_decide": MemBudget(temp_hi=80 * MB),
     "serve_decide_batch": MemBudget(temp_hi=440 * MB),
+    # ISSUE 13 sharded-store variant (pinned 2026-08-04): 329.3 MB vs
+    # 325.5 unsharded — the sharding constraints add layout ops, not
+    # buffers. The band pins that sharding the [C] axis never starts
+    # materializing a gathered (unsharded) store copy: that would
+    # roughly double the temp bytes and breach here on CPU before a
+    # multi-chip window ever compiles it.
+    "serve_decide_batch_sharded": MemBudget(temp_hi=445 * MB),
 }
 
 # lane counts the advisor sweeps (the bench's production range; 1024
@@ -343,20 +350,26 @@ def audit_memory(
             ),
         }
 
-    # -- serving batch program (ISSUE 10): the bank-broadcast rule on
-    # its native micro-batch axis. `serve/aot.py` vmaps apply_and_drain
-    # over the K gathered sessions, so a bank access slipping into a
-    # lane-dependent cond/switch branch would materialize one bank
-    # copy per in-flight request — the same 19.4 GB hazard class,
-    # caught here on CPU before a serving deploy ever sees it. (No
-    # lane-fit: the serve batch width is a latency knob bounded by
-    # max_batch, not a throughput axis swept to HBM capacity.)
-    if names is None or "serve_decide_batch" in names:
+    # -- serving batch programs (ISSUE 10/13): the bank-broadcast rule
+    # on their native micro-batch axis. `serve/aot.py` vmaps
+    # apply_and_drain over the K gathered sessions, so a bank access
+    # slipping into a lane-dependent cond/switch branch would
+    # materialize one bank copy per in-flight request — the same
+    # 19.4 GB hazard class, caught here on CPU before a serving deploy
+    # ever sees it. The dp-sharded variant is scanned too: under the
+    # mesh a broadcast bank would materialize per SHARD, so the rule
+    # must see one replicated bank, not a per-request (or per-device)
+    # copy. (No lane-fit: the serve batch width is a latency knob
+    # bounded by max_batch, not a throughput axis swept to HBM
+    # capacity — the hot-set axis has its own advisor,
+    # obs.memory.hot_set_fit.)
+    for sname in ("serve_decide_batch", "serve_decide_batch_sharded"):
+        if names is not None and sname not in names:
+            continue
         from ..serve.aot import SERVE_AUDIT_BATCH
 
         found.extend(check_bank_broadcast(
-            "serve_decide_batch", programs["serve_decide_batch"], bank,
-            SERVE_AUDIT_BATCH,
+            sname, programs[sname], bank, SERVE_AUDIT_BATCH,
         ))
     return found, measured
 

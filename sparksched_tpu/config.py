@@ -139,15 +139,19 @@ SERVE_KEYS = frozenset({
     # ISSUE 10: the top-level `serve:` block — the AOT decision
     # service's surface (sparksched_tpu/serve/session.py:
     # store_from_config), validated with the same fail-loud contract
-    "capacity",  # session-store slots (one live cluster per tenant)
+    "capacity",  # sessions the store admits (one live cluster per tenant)
     "max_batch",  # micro-batch width K (the batched AOT program's shape)
-    "linger_ms",  # bounded linger window of the micro-batching front
+    "linger_ms",  # bounded linger window (the `front: linger` A/B partner)
     "deterministic",  # greedy serving (default True)
     "donate",  # donate the store buffer to the serve programs
     "seed",  # base key for session resets / sampling
     # ISSUE 11 instrumentation (default off, zero-cost off):
     "trace",  # per-request span stamps + runlog `trace` records
     "metrics",  # attach an obs.metrics.MetricsRegistry to the store
+    # ISSUE 13: continuous batching + the sharded, host-paged store
+    "front",  # batching front: continuous (default) | linger
+    "hot_capacity",  # device slots; < capacity pages idle sessions to host
+    "shard_dp",  # shard the device store over a dp mesh (N | "auto")
 })
 
 OBS_KEYS = frozenset({

@@ -123,6 +123,16 @@ class MicroRec(struct.PyTreeNode):
     reset: jnp.ndarray  # bool []
 
 
+def take_slot(store, i):
+    """One session's `LoopState` gathered from a [C]-stacked store at a
+    (possibly traced) slot index — the serve programs' gather
+    (`serve/aot.py`) and the session pager's host-side page-out
+    (`serve/session.py`) share this one definition, so the paged copy
+    of a slot is by construction the same view the compiled program
+    serves."""
+    return jax.tree_util.tree_map(lambda a: a[i], store)
+
+
 def init_loop_state(state: EnvState) -> LoopState:
     n = state.exec_job.shape[0]
     return LoopState(

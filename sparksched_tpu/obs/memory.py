@@ -492,6 +492,91 @@ def jax_shape_struct(shape: tuple, dtype):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def hot_set_fit(
+    slot_tree,
+    candidates: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048),
+    budget_bytes: int = TPU_HBM_BUDGET_BYTES,
+    fixed_bytes: int = 0,
+    tile_pad: bool = True,
+    dp: int = 1,
+) -> dict[str, Any]:
+    """Hot-set capacity model for the paged session store (ISSUE 13) —
+    the lane-fit advisor's serving analog.
+
+    `slot_tree` is ONE session's slot as abstract leaves (arrays or
+    ShapeDtypeStructs — the `LoopState` one `SessionStore` slot holds).
+    The store's HBM cost is linear in the HOT capacity H: the
+    [H]-stacked slot store is the only store-sized buffer the donated
+    serve programs keep resident, so bytes(H) = fixed + store(H),
+    where store(H) is evaluated EXACTLY per candidate (every slot leaf
+    sized at leading dim H under the TPU tiled-layout model — no
+    fitting, and monotone in H by construction, which the pager test
+    pins). `fixed_bytes` carries the replicated constants (the
+    workload bank, params) plus whatever working-set allowance the
+    caller budgets for the serve program itself.
+
+    With `dp` > 1 (the sharded store), candidates stay GLOBAL hot
+    capacities but each is evaluated at its per-device shard width
+    ceil(H/dp) against a per-chip budget, mirroring `lane_fit`'s mesh
+    mode — the store's leading axis is `P('dp')`-sharded while the
+    bank stays replicated (the fixed term).
+
+    Returns `{budget_bytes, fixed_bytes, slot_bytes, max_hot_fit,
+    candidates: [{hot, est_bytes, fits[, hot_per_device]}]}` —
+    `slot_bytes` is the marginal PER-DEVICE cost of one more GLOBAL
+    slot at large H (the est_bytes slope in global H, i.e. already
+    divided by dp), so "how many more global sessions fit the
+    per-chip budget" is one division away under any mesh."""
+    leaves = [
+        (tuple(int(d) for d in getattr(a, "shape", ())),
+         getattr(a, "dtype", None))
+        for a in _tree_leaves(slot_tree)
+    ]
+
+    def store_bytes(h: int) -> int:
+        return sum(
+            aval_bytes(jax_shape_struct((h,) + shape, dtype), tile_pad)
+            for shape, dtype in leaves
+            if dtype is not None
+        )
+
+    dp = max(1, int(dp))
+    rows = []
+    max_fit = 0
+    for h in sorted(int(c) for c in candidates):
+        shard = -(-h // dp)
+        est = int(fixed_bytes) + store_bytes(shard)
+        fits = est <= budget_bytes
+        if fits:
+            max_fit = max(max_fit, h)
+        row = {"hot": h, "est_bytes": est, "fits": fits}
+        if dp > 1:
+            row["hot_per_device"] = shard
+        rows.append(row)
+    out = {
+        "budget_bytes": int(budget_bytes),
+        "fixed_bytes": int(fixed_bytes),
+        # marginal bytes of one more GLOBAL slot (the large-H slope
+        # of est_bytes, where per-leaf tile padding has amortized) —
+        # computed at per-device shard widths so the division against
+        # the per-chip budget yields GLOBAL sessions under any dp
+        "slot_bytes": (
+            store_bytes(-(-2048 // dp)) - store_bytes(-(-1024 // dp))
+        ) // 1024,
+        "max_hot_fit": max_fit,
+        "candidates": rows,
+    }
+    if dp > 1:
+        out["dp"] = dp
+    return out
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
 def gb(n: int | float) -> float:
     """Decimal GB, the unit PERF.md and the budget table speak."""
     return round(float(n) / 1e9, 2)

@@ -19,12 +19,14 @@ from .aot import (
 )
 from .loadgen import generate_arrivals, run_open_loop
 from .session import (
+    ContinuousBatcher,
     MicroBatcher,
     ServeResult,
     SessionError,
     SessionQuarantined,
     SessionStore,
     Ticket,
+    front_from_config,
     store_from_config,
 )
 
@@ -36,11 +38,13 @@ __all__ = [
     "serve_decide_fn",
     "generate_arrivals",
     "run_open_loop",
+    "ContinuousBatcher",
     "MicroBatcher",
     "ServeResult",
     "SessionError",
     "SessionQuarantined",
     "SessionStore",
     "Ticket",
+    "front_from_config",
     "store_from_config",
 ]

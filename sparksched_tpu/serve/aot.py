@@ -56,6 +56,7 @@ from ..env.flat_loop import (
     _lane_done,
     apply_and_drain,
     aux_action_fields,
+    take_slot,
 )
 from ..env.health import reward_health, state_health
 from ..env.observe import observe
@@ -159,17 +160,26 @@ def serve_decide_fn(
     bank: WorkloadBank,
     policy_fn: Callable,
     knobs: dict[str, Any] | None = None,
+    shard=None,
 ) -> Callable:
     """The single-session store program:
     `(store [C], slot, key, force_stage, force_nexec, use_force) ->
     (store [C], ServeOut)`. Gather one lane, decide unbatched, scatter
-    back; the store argument is meant to be donated at compile time."""
+    back; the store argument is meant to be donated at compile time.
+    With `shard` (a `NamedSharding` over the store's leading [C] axis,
+    ISSUE 13), the store is sharding-constrained at entry and exit so
+    the SPMD partitioner keeps the [C] session stack distributed over
+    the `dp` mesh instead of gathering it to one device around the
+    slot update — sessions are embarrassingly parallel, so the only
+    cross-device traffic is the served slot itself."""
     kn = SERVE_KNOBS | (knobs or {})
 
     def fn(store: LoopState, slot, key, force_stage, force_nexec,
            use_force):
         with annotate("serve/decide"):
-            ls = jax.tree_util.tree_map(lambda a: a[slot], store)
+            if shard is not None:
+                store = jax.lax.with_sharding_constraint(store, shard)
+            ls = take_slot(store, slot)
             ls2, out = _decide_one(
                 params, bank, policy_fn, ls, key,
                 force_stage, force_nexec, use_force, kn,
@@ -177,6 +187,8 @@ def serve_decide_fn(
             store2 = jax.tree_util.tree_map(
                 lambda s, v: s.at[slot].set(v), store, ls2
             )
+            if shard is not None:
+                store2 = jax.lax.with_sharding_constraint(store2, shard)
         return store2, out
 
     return fn
@@ -188,6 +200,7 @@ def serve_decide_batch_fn(
     batch_policy_fn: Callable,
     batch: int,
     knobs: dict[str, Any] | None = None,
+    shard=None,
 ) -> Callable:
     """The micro-batched store program:
     `(store [C], slots [K], key) -> (store [C], ServeOut-of-[K])`.
@@ -195,16 +208,20 @@ def serve_decide_batch_fn(
     width-K `batch_policy` compaction is exactly a serving-batch
     primitive), vmapped apply-and-drain, scatter back. Padding slots
     carry index C: gathers clamp to a real lane whose results are then
-    dropped by the `mode="drop"` scatter and masked in the output."""
+    dropped by the `mode="drop"` scatter and masked in the output.
+    `shard` (ISSUE 13) constrains the [C] store axis to the `dp` mesh
+    at entry and exit, exactly as in `serve_decide_fn`."""
     kn = SERVE_KNOBS | (knobs or {})
     K = int(batch)
 
     def fn(store: LoopState, slots, key):
         with annotate("serve/decide_batch"):
+            if shard is not None:
+                store = jax.lax.with_sharding_constraint(store, shard)
             C = store.mode.shape[0]
             valid = slots < C
             idx = jnp.minimum(slots, C - 1)
-            ls = jax.tree_util.tree_map(lambda a: a[idx], store)
+            ls = take_slot(store, idx)
             env0 = ls.env
             was_done = jax.vmap(_lane_done)(env0)
             k_pol, k_env = jax.random.split(key)
@@ -246,6 +263,8 @@ def serve_decide_batch_fn(
             store2 = jax.tree_util.tree_map(
                 lambda s, v: s.at[slots].set(v, mode="drop"), store, ls2
             )
+            if shard is not None:
+                store2 = jax.lax.with_sharding_constraint(store2, shard)
         return store2, out
 
     return fn
@@ -265,15 +284,23 @@ def aot_compile(fn: Callable, *abstract_args, donate_store: bool = True):
     return compiled, time.perf_counter() - t0
 
 
-def abstract_like(tree):
+def abstract_like(tree, keep_sharding: bool = False):
     """ShapeDtypeStructs of a concrete pytree — the `.lower()` argument
-    spec (lowering never needs the store's values, only its shapes)."""
-    return jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(
-            jnp.shape(a), jnp.result_type(a)
-        ),
-        tree,
-    )
+    spec (lowering never needs the store's values, only its shapes).
+    With `keep_sharding` (the dp-sharded store, ISSUE 13), each leaf's
+    concrete `.sharding` rides the struct, so the AOT lowering bakes
+    the store's mesh layout into the executable — donation included —
+    instead of compiling a single-device program and resharding on
+    every call."""
+    def one(a):
+        kw = {}
+        if keep_sharding and getattr(a, "sharding", None) is not None:
+            kw["sharding"] = a.sharding
+        return jax.ShapeDtypeStruct(
+            jnp.shape(a), jnp.result_type(a), **kw
+        )
+
+    return jax.tree_util.tree_map(one, tree)
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +348,22 @@ def serve_callables(
     i32 = jax.ShapeDtypeStruct((), jnp.int32)
     b = jax.ShapeDtypeStruct((), jnp.bool_)
     slots = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    # ISSUE 13: the dp-sharded store variant joins the registry. The
+    # sharding constraint is part of the traced program (one
+    # sharding_constraint eqn per store leaf at entry and exit), so
+    # the audited jaxpr IS the sharded configuration — eqn counts are
+    # mesh-size-invariant (the mesh is a lowering parameter, not an
+    # equation), so the pin holds on the 1-device analysis CLI and the
+    # 8-virtual-device test mesh alike. The mesh size is clamped to a
+    # DIVISOR of the audit capacity: the [capacity]-wide store axis
+    # cannot shard over more (or non-dividing) devices, and the audit
+    # must trace on any host topology, not just the measured 1/8.
+    import math
+
+    from ..parallel import lane_sharding, make_mesh
+
+    dp = math.gcd(len(jax.devices()), capacity)
+    shard = lane_sharding(make_mesh(dp))
     return {
         "serve_decide": (
             serve_decide_fn(params, bank, pol),
@@ -328,6 +371,12 @@ def serve_callables(
         ),
         "serve_decide_batch": (
             serve_decide_batch_fn(params, bank, bpol, batch),
+            (store, slots, key),
+        ),
+        "serve_decide_batch_sharded": (
+            serve_decide_batch_fn(
+                params, bank, bpol, batch, shard=shard
+            ),
             (store, slots, key),
         ),
     }

@@ -153,6 +153,14 @@ def run_open_loop(
     outstanding replies (open loop). Latency is measured from the
     SCHEDULED arrival to the harvest of the reply, in ms.
 
+    `batcher` is either front (ISSUE 13): the driver speaks only
+    `submit`/`poll`/`flush`/`pending`. Under the `ContinuousBatcher`
+    the per-iteration `poll()` IS the continuous-batching engine —
+    each call re-fills the width-K slot with whatever arrived while
+    the previous compiled call was in flight; under the `MicroBatcher`
+    it is the linger-window check. The summary records which front ran
+    (`front`), so paired A/B rows are self-describing.
+
     Returns a dict with exact counters (`requests` scheduled ==
     `completed` served + `capacity_rejections` turned away at submit;
     `errors` and `good` partition within `completed`), the throughput
@@ -259,6 +267,7 @@ def run_open_loop(
     makespan = time.perf_counter() - t0
     out: dict[str, Any] = {
         "requests": n,
+        "front": getattr(batcher, "front_name", "unknown"),
         "completed": completed,
         "errors": errors,
         "good": good,

@@ -1,13 +1,35 @@
 """Persistent decision-serving sessions over the AOT programs.
 
 A `SessionStore` holds one live on-device cluster (`LoopState`) per
-tenant in a fixed-capacity [C]-stacked store, and serves decisions
-through the two ahead-of-time-compiled programs built at construction
-(`serve/aot.py`): the unbatched single-session path and the width-K
-micro-batched path. The store buffer is DONATED to every serve call,
-so steady-state decisions update the [C] cluster states in place —
+tenant and serves decisions through the two ahead-of-time-compiled
+programs built at construction (`serve/aot.py`): the unbatched
+single-session path and the width-K micro-batched path. The DEVICE
+store is a fixed `[hot_capacity]`-stacked buffer DONATED to every
+serve call, so steady-state decisions update cluster states in place —
 zero store-sized allocation, zero tracing, zero recompiles after the
 constructor's warmup call.
+
+Since ISSUE 13 the store separates SESSIONS from SLOTS:
+
+- `capacity` is the number of live sessions the store admits;
+  `hot_capacity` (default: `capacity`) is the number of device slots.
+  When `hot_capacity < capacity`, idle sessions' slots are PAGED to
+  host RAM (`jax.device_put`/`device_get` round-trips, bit-exact —
+  test-pinned) and paged back in on their next request, so HBM holds
+  only the hot set. Victims are chosen quarantined-first, then
+  least-recently-served. `hot_set_advice()` (obs/memory.py:
+  `hot_set_fit`) models bytes(H) = fixed + H x slot_bytes against the
+  HBM budget — the lane-fit advisor's serving analog.
+- session ids are stable public handles; the sid -> slot mapping is
+  internal. Free sids and free slots are MAINTAINED FREE-LISTS, so
+  `create` is O(1) at any capacity (the r10 store's linear free-slot
+  scan is gone).
+- with `mesh` (the PR-6 1-D `dp` mesh), the device store's leading
+  axis is sharded `P('dp')` over the mesh — sessions are
+  embarrassingly parallel, so C sessions spread their HBM over dp
+  chips — with donation and AOT lowering intact (the lowering bakes
+  the `NamedSharding` in via the argument structs; decision parity vs
+  the unsharded store is test-pinned).
 
 Session lifecycle (`create` / `step` / `decide` / `close`):
 
@@ -22,40 +44,55 @@ Session lifecycle (`create` / `step` / `decide` / `close`):
 - every served decision carries the in-JIT health sentinel mask
   (env/health.py, ISSUE 9): a non-zero mask QUARANTINES the session —
   it is never served again (decide/step raise `SessionQuarantined`),
-  but its slot is only reclaimed by an explicit `close`. A poisoned
-  cluster state must not keep emitting decisions.
-- `close(sid)` frees the slot.
+  but its session id is only reclaimed by an explicit `close` (its
+  device slot MAY be paged out to make room for hot sessions — a
+  poisoned cluster is the best eviction candidate there is).
+- `close(sid)` frees the session id (and its slot, if resident).
 
-`MicroBatcher` is the batching front: requests accumulate until either
-`max_batch` sessions are pending or the oldest request has waited
-`linger_ms` (the bounded linger window), then flush as ONE compiled
-width-K call; a flush of a single pending request falls back to the
-unbatched AOT path (no padded batch work for a lone request). It is
-deliberately synchronous — `submit` returns a `Ticket`, and `poll()`
-(or a full batch) flushes — so a network front can drive it from any
-event loop and the latency bench can measure it deterministically.
+Batching fronts — two, sharing one ticket/trace/metrics contract:
 
-Observability (ISSUE 11): both layers are instrumented, OFF by
-default and zero-cost off — `metrics` (an `obs.metrics.MetricsRegistry`
-or None) receives the admission/occupancy view ORCA-style schedulers
-need (queue depth at flush, batch K-fill, per-request linger waits,
-flush reason size|linger|forced, quarantine and capacity-rejection
-counters), and `trace=True` stamps a Dapper-style per-request span
-walk (trace id minted at `Ticket` creation; submit -> batch_admit ->
-dispatch -> device_compute -> scatter_back -> reply) emitted as
-runlog `trace` records and bridged into the `annotate("serve/flush")`
-named scope. All instrumentation is host-side: the compiled serve
+- `ContinuousBatcher` (the ISSUE-13 default): iteration-level
+  (continuous) batching in the Orca sense, adapted to the synchronous
+  host front. There is no linger timer: the width-K serving slot
+  re-fills from the queue the moment the previous compiled call
+  returns (`poll()`/`pump()`), and partial fills are free because the
+  compiled program drops padding lanes via `mode="drop"`. Admission is
+  per-tenant FIFO with round-robin rotation across tenants, which
+  gives a structural starvation bound: a queue-head request is
+  admitted within ceil(S/K) batches of S backlogged tenants — no
+  tenant's flood can starve another (test-pinned). A session that
+  turns unservable mid-stream — quarantined by a decision's health
+  mask, or closed/quarantined at dispatch — has its queued requests
+  EVICTED (each fails its own ticket with the same error class);
+  co-queued tenants are unaffected.
+- `MicroBatcher` (the r10/r11 fixed-linger front, kept as the A/B
+  partner): requests accumulate until either `max_batch` sessions are
+  pending or the oldest request has waited `linger_ms`, then flush as
+  ONE compiled width-K call. `bench_serve_scale`'s paired rows measure
+  both fronts at identical seeded offered loads.
+
+Observability (ISSUE 11): both fronts and the store are instrumented,
+OFF by default and zero-cost off — `metrics` receives the
+admission/occupancy view (queue depth, batch K-fill, waits, flush
+reason, quarantine/paging/capacity counters), and `trace=True` stamps
+a Dapper-style per-request span walk (submit -> batch_admit ->
+dispatch -> device_compute -> scatter_back -> reply) emitted as runlog
+`trace` records. All instrumentation is host-side: the compiled serve
 programs are untouched (the analysis registry pins their jaxprs
 byte-identical with instrumentation off).
 
 Config surface: the top-level `serve:` YAML block
 (`config.SERVE_KEYS`), validated loudly like the `health:`/`chaos:`
 blocks — a typo'd knob must fail, not silently serve with defaults.
+`front: continuous|linger` picks the batching front
+(`front_from_config`); `hot_capacity` enables the pager; `shard_dp`
+shards the store over a dp mesh.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any
 
 import jax
@@ -64,7 +101,7 @@ import numpy as np
 
 from ..config import SERVE_KEYS, EnvParams
 from ..env import core
-from ..env.flat_loop import init_loop_state
+from ..env.flat_loop import init_loop_state, take_slot
 from ..obs.tracing import RequestTrace, annotate
 from ..workload.bank import WorkloadBank
 from .aot import (
@@ -116,10 +153,12 @@ class ServeResult:
 
 
 class SessionStore:
-    """Fixed-capacity persistent session store over donated AOT
-    programs. Not thread-safe by design: a serving front owns one
-    store per worker (the donation discipline — exactly one live
-    reference to the store buffer — does not compose with concurrent
+    """Persistent session store over donated AOT programs: `capacity`
+    sessions over `hot_capacity` device slots (idle sessions page to
+    host RAM when the two differ), optionally sharded over a `dp`
+    mesh. Not thread-safe by design: a serving front owns one store
+    per worker (the donation discipline — exactly one live reference
+    to the store buffer — does not compose with concurrent
     mutation)."""
 
     def __init__(
@@ -129,6 +168,8 @@ class SessionStore:
         scheduler,
         capacity: int = 64,
         *,
+        hot_capacity: int | None = None,
+        mesh=None,
         max_batch: int = 8,
         deterministic: bool = True,
         donate: bool = True,
@@ -139,14 +180,28 @@ class SessionStore:
         metrics=None,
         trace: bool = False,
     ) -> None:
-        if not 1 <= max_batch <= capacity:
+        hot = int(capacity if hot_capacity is None else hot_capacity)
+        if not 1 <= hot <= capacity:
             raise ValueError(
-                f"max_batch={max_batch} must be in [1, capacity="
+                f"hot_capacity={hot} must be in [1, capacity="
                 f"{capacity}]"
+            )
+        if not 1 <= max_batch <= hot:
+            raise ValueError(
+                f"max_batch={max_batch} must be in [1, hot_capacity="
+                f"{hot}]"
+            )
+        if mesh is not None and hot % mesh.size != 0:
+            raise ValueError(
+                f"hot_capacity={hot} must divide evenly over the "
+                f"{mesh.size}-device mesh (each device holds "
+                "hot_capacity/dp slots)"
             )
         self.params = params
         self.bank = bank
         self.capacity = int(capacity)
+        self.hot_capacity = hot
+        self.mesh = mesh
         self.max_batch = int(max_batch)
         self.donate = bool(donate)
         self.knobs = SERVE_KNOBS | (knobs or {})
@@ -167,6 +222,12 @@ class SessionStore:
         pol, bpol = scheduler.serve_policies(
             deterministic=deterministic
         )
+        shard = None
+        if mesh is not None:
+            from ..parallel import lane_sharding
+
+            shard = lane_sharding(mesh)
+        self._shard = shard
         self._reset1 = jax.jit(
             lambda k: init_loop_state(core.reset(params, bank, k))
         )
@@ -177,22 +238,25 @@ class SessionStore:
             donate_argnums=(0,) if donate else (),
         )
 
-        # the [C] store starts as C copies of one dummy reset episode;
-        # create() overwrites a slot with its own seeded reset
+        # the [hot] device store starts as copies of one dummy reset
+        # episode; create() overwrites a slot with its own seeded reset
         ls0 = self._reset1(jax.random.fold_in(self._base_key, 2**19))
         store = jax.tree_util.tree_map(
             lambda a: jnp.broadcast_to(
-                a, (self.capacity,) + a.shape
+                a, (self.hot_capacity,) + a.shape
             ).copy(),
             ls0,
         )
+        if shard is not None:
+            store = jax.device_put(store, shard)
 
         # ---- AOT lowering + compile (the cold start) ----
-        fn1 = serve_decide_fn(params, bank, pol, self.knobs)
+        fn1 = serve_decide_fn(params, bank, pol, self.knobs,
+                              shard=shard)
         fnk = serve_decide_batch_fn(
-            params, bank, bpol, self.max_batch, self.knobs
+            params, bank, bpol, self.max_batch, self.knobs, shard=shard
         )
-        st_abs = abstract_like(store)
+        st_abs = abstract_like(store, keep_sharding=shard is not None)
         key = abstract_like(self._base_key)
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         b = jax.ShapeDtypeStruct((), jnp.bool_)
@@ -205,16 +269,38 @@ class SessionStore:
         )
         self.compile_secs = {"decide": secs1, "decide_batch": secsk}
 
-        # host-side slot bookkeeping
+        # host-side session/slot bookkeeping: sids are public handles,
+        # slots are device positions. Both free pools are maintained
+        # free-lists (pop/append), so create() is O(1) at any
+        # capacity — the paging work needs capacities past 64, where
+        # the old linear free-slot scan would start to show.
         self._live = np.zeros(self.capacity, bool)
         self._quarantined = np.zeros(self.capacity, bool)
+        self._slot_of = np.full(self.capacity, -1, np.int32)
+        self._sid_of = np.full(self.hot_capacity, -1, np.int32)
+        # init [cap-1 .. 0] so pop() hands out 0, 1, 2, ... on a fresh
+        # store (the r10 smallest-first order), then LIFO reuse. The
+        # slot free-list exists only under paging — the unpaged store
+        # maps sid == slot identically and must not carry a stale
+        # "every slot free" list beside it
+        self._free_sids = list(range(self.capacity - 1, -1, -1))
+        self._free_slots = (
+            list(range(self.hot_capacity - 1, -1, -1))
+            if self.hot_capacity < self.capacity else []
+        )
+        self._cold: dict[int, Any] = {}
+        self._last_use = np.zeros(self.hot_capacity, np.int64)
+        self._tick = 0
         self.stats = {
             "serve_decisions": 0,
             "serve_batched_decisions": 0,
             "serve_batch_calls": 0,
             "serve_quarantines": 0,
             "serve_sessions_live": 0,
+            "serve_sessions_hot": 0,
             "serve_capacity_rejections": 0,
+            "serve_page_ins": 0,
+            "serve_page_outs": 0,
         }
 
         # ---- warmup: one call per program, so the warm path never
@@ -226,7 +312,7 @@ class SessionStore:
             _i32(0), _i32(-1), _i32(0), jnp.bool_(False)
         )
         self._store, _ = self._callk(
-            jnp.full((self.max_batch,), self.capacity, _i32)
+            jnp.full((self.max_batch,), self.hot_capacity, _i32)
         )
         jax.block_until_ready(self._store.mode)
         self.warmup_secs = time.perf_counter() - t0
@@ -239,9 +325,9 @@ class SessionStore:
         self._calls += 1
         return jax.random.fold_in(self._base_key, self._calls)
 
-    def _call1(self, sid, fstage, fnexec, use_force):
+    def _call1(self, slot, fstage, fnexec, use_force):
         return self._c1(
-            self._store, sid, self._next_key(), fstage, fnexec,
+            self._store, slot, self._next_key(), fstage, fnexec,
             use_force,
         )
 
@@ -274,38 +360,164 @@ class SessionStore:
         }
         return out
 
+    # -- the hot/cold pager (ISSUE 13) ------------------------------------
+
+    def _page_out(self, slot: int) -> None:
+        """Move one resident session's slot to host RAM (numpy pytree).
+        The host copy is the exact device view (`take_slot` — the same
+        gather the serve programs run), so page-out -> page-in is
+        bit-exact (test-pinned)."""
+        vsid = int(self._sid_of[slot])
+        self._cold[vsid] = jax.device_get(take_slot(self._store, slot))
+        self._sid_of[slot] = -1
+        self._slot_of[vsid] = -1
+        self.stats["serve_page_outs"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("serve_page_outs")
+
+    def _alloc_slot(self, pinned: set[int]) -> int:
+        """A free device slot, evicting if needed. Victim preference:
+        a quarantined resident first (never served again — the best
+        session to keep cold), then the least-recently-served live
+        session; `pinned` sids (the current batch) are never
+        evicted."""
+        if self.hot_capacity == self.capacity:
+            raise AssertionError("unpaged store never allocates slots")
+        if self._free_slots:
+            return self._free_slots.pop()
+        cands = [
+            s for s in range(self.hot_capacity)
+            if self._sid_of[s] >= 0 and int(self._sid_of[s])
+            not in pinned
+        ]
+        assert cands, (
+            "no evictable slot — max_batch <= hot_capacity makes this "
+            "unreachable"
+        )
+        quar = [s for s in cands if self._quarantined[self._sid_of[s]]]
+        victim = min(
+            quar or cands, key=lambda s: int(self._last_use[s])
+        )
+        self._page_out(victim)
+        return victim
+
+    def _ensure_hot(self, sids: list[int]) -> list[int]:
+        """Device slots for `sids`, paging cold sessions in (and idle
+        ones out) as needed; bumps the LRU clock of every touched
+        slot."""
+        pinned = set(sids)
+        slots = []
+        for sid in sids:
+            slot = int(self._slot_of[sid])
+            if slot < 0:
+                slot = self._alloc_slot(pinned)
+                self._store = self._write_slot(
+                    self._store, _i32(slot), self._cold.pop(sid)
+                )
+                self._slot_of[sid] = slot
+                self._sid_of[slot] = sid
+                self.stats["serve_page_ins"] += 1
+                if self.metrics is not None:
+                    self.metrics.counter("serve_page_ins")
+            self._tick += 1
+            self._last_use[slot] = self._tick
+            slots.append(slot)
+        self.stats["serve_sessions_hot"] = int(
+            (self._sid_of >= 0).sum()
+        )
+        return slots
+
+    def hot_set_advice(
+        self,
+        candidates: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048),
+        budget_bytes: int | None = None,
+    ) -> dict[str, Any]:
+        """Hot-set capacity model (`obs.memory.hot_set_fit`): how many
+        device slots fit the HBM budget, with the replicated workload
+        bank as the fixed cost — the serving analog of the lane-fit
+        advisor (predictions are monotone in hot capacity,
+        test-pinned). Under a dp mesh the budget is per device and
+        each chip holds hot/dp slots, so candidates are evaluated at
+        their per-shard width."""
+        from ..obs.memory import (
+            TPU_HBM_BUDGET_BYTES,
+            aval_bytes,
+            hot_set_fit,
+        )
+
+        slot = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+            self._store,
+        )
+        fixed = sum(
+            aval_bytes(jax.ShapeDtypeStruct(l.shape, l.dtype))
+            for l in jax.tree_util.tree_leaves(self.bank)
+        )
+        return hot_set_fit(
+            slot, candidates=candidates,
+            budget_bytes=(
+                TPU_HBM_BUDGET_BYTES if budget_bytes is None
+                else budget_bytes
+            ),
+            fixed_bytes=fixed,
+            dp=1 if self.mesh is None else int(self.mesh.size),
+        )
+
     # -- session lifecycle -------------------------------------------------
 
     def create(self, seed: int | None = None) -> int:
-        """Reset a fresh episode into a free slot; returns the session
-        id. Raises `RuntimeError` when the store is full."""
-        free = np.flatnonzero(~self._live & ~self._quarantined)
-        if free.size == 0:
+        """Reset a fresh episode into a free session; returns the
+        session id (O(1) — maintained free-lists, no scan). Raises
+        `RuntimeError` when the store is full."""
+        if not self._free_sids:
             self.stats["serve_capacity_rejections"] += 1
             if self.metrics is not None:
                 self.metrics.counter("serve_capacity_rejections")
             raise RuntimeError(
-                f"session store full ({self.capacity} slots live or "
-                "quarantined); close sessions first"
+                f"session store full ({self.capacity} sessions live "
+                "or quarantined); close sessions first"
             )
-        sid = int(free[0])
+        sid = self._free_sids.pop()
         k = (
             jax.random.fold_in(self._base_key, 2**20 + sid)
             if seed is None
             else jax.random.PRNGKey(seed)
         )
+        if self.hot_capacity == self.capacity:
+            # unpaged store: identity sid == slot, the r10/r11 layout
+            slot = sid
+        else:
+            slot = self._alloc_slot(set())
         self._store = self._write_slot(
-            self._store, _i32(sid), self._reset1(k)
+            self._store, _i32(slot), self._reset1(k)
         )
+        self._slot_of[sid] = slot
+        self._sid_of[slot] = sid
+        self._tick += 1
+        self._last_use[slot] = self._tick
         self._live[sid] = True
         self.stats["serve_sessions_live"] = int(self._live.sum())
+        self.stats["serve_sessions_hot"] = int(
+            (self._sid_of >= 0).sum()
+        )
         return sid
 
     def close(self, sid: int) -> None:
         self._check_sid(sid, allow_quarantined=True)
+        slot = int(self._slot_of[sid])
+        if slot >= 0:
+            self._sid_of[slot] = -1
+            if self.hot_capacity < self.capacity:
+                self._free_slots.append(slot)
+        self._slot_of[sid] = -1
+        self._cold.pop(sid, None)
         self._live[sid] = False
         self._quarantined[sid] = False
+        self._free_sids.append(sid)
         self.stats["serve_sessions_live"] = int(self._live.sum())
+        self.stats["serve_sessions_hot"] = int(
+            (self._sid_of >= 0).sum()
+        )
 
     def _check_sid(self, sid: int, allow_quarantined: bool = False
                    ) -> None:
@@ -335,8 +547,9 @@ class SessionStore:
     def decide(self, sid: int) -> ServeResult:
         """One policy decision on the unbatched AOT path."""
         self._check_sid(sid)
+        [slot] = self._ensure_hot([sid])
         out = self._served(lambda: self._call1(
-            _i32(sid), _i32(-1), _i32(0), jnp.bool_(False)
+            _i32(slot), _i32(-1), _i32(0), jnp.bool_(False)
         ))
         res = ServeResult(sid, out, None, batched=False)
         self._apply_health(sid, res.health_mask)
@@ -348,8 +561,9 @@ class SessionStore:
         """Apply a CALLER-chosen action (same compiled program; the
         policy's pick is overridden by the forced-action select)."""
         self._check_sid(sid)
+        [slot] = self._ensure_hot([sid])
         out = self._served(lambda: self._call1(
-            _i32(sid), _i32(stage_idx), _i32(num_exec),
+            _i32(slot), _i32(stage_idx), _i32(num_exec),
             jnp.bool_(True),
         ))
         res = ServeResult(sid, out, None, batched=False)
@@ -373,8 +587,9 @@ class SessionStore:
             raise ValueError("duplicate session ids in one batch")
         if len(sids) == 1:
             return [self.decide(sids[0])]
-        slots = np.full(self.max_batch, self.capacity, np.int32)
-        slots[: len(sids)] = sids
+        batch_slots = self._ensure_hot(sids)
+        slots = np.full(self.max_batch, self.hot_capacity, np.int32)
+        slots[: len(sids)] = batch_slots
         out = self._served(lambda: self._callk(jnp.asarray(slots)))
         results = []
         for i, sid in enumerate(sids):
@@ -428,8 +643,48 @@ class Ticket:
         return self.result is not None or self.error is not None
 
 
+def _finish_ticket(t: Ticket, store: SessionStore, metrics, runlog
+                   ) -> None:
+    """Resolve one ticket's instrumentation: merge the store's device
+    spans, stamp `reply`, emit the runlog `trace` record, and feed the
+    per-span histograms. ONE implementation shared by both batching
+    fronts — the paired A/B rows must measure identical ticket
+    accounting."""
+    m = metrics
+    if m is not None:
+        m.counter("serve_requests_total")
+        if t.error is not None:
+            m.counter("serve_request_errors")
+    if t.trace is None:
+        return
+    spans = store.last_spans
+    if t.error is None and spans is not None:
+        t.trace.spans.update(spans)
+    t.trace.stamp("reply")
+    if m is not None:
+        s = t.trace.spans
+        segs = (
+            ("serve_span_queue_ms", "submit", "batch_admit"),
+            ("serve_span_device_ms", "dispatch", "device_compute"),
+            ("serve_span_scatter_ms", "device_compute",
+             "scatter_back"),
+            ("serve_span_total_ms", "submit", "reply"),
+        )
+        for name, a, b in segs:
+            if a in s and b in s:
+                m.observe(name, (s[b] - s[a]) * 1e3)
+    if runlog is not None:
+        runlog.trace(
+            t.trace.trace_id, t.trace.offsets_ms(),
+            session_id=t.session_id,
+            error=None if t.error is None
+            else type(t.error).__name__,
+        )
+
+
 class MicroBatcher:
-    """Bounded-linger micro-batching front over a `SessionStore`.
+    """Bounded-linger micro-batching front over a `SessionStore` — the
+    r10/r11 front, kept as the continuous batcher's A/B partner.
 
     `submit(sid)` enqueues and flushes immediately when `max_batch`
     requests are pending; `poll()` flushes when the OLDEST pending
@@ -445,6 +700,8 @@ class MicroBatcher:
     `RequestTrace` per ticket and — when `runlog` is given — emits one
     runlog `trace` record per served request, with the store-level
     device spans merged in when the store also has `trace` on."""
+
+    front_name = "linger"
 
     def __init__(self, store: SessionStore, linger_ms: float = 1.0,
                  *, metrics=None, runlog=None, trace: bool = False
@@ -481,39 +738,7 @@ class MicroBatcher:
         return False
 
     def _finish(self, t: Ticket) -> None:
-        """Resolve one ticket's instrumentation: merge the store's
-        device spans, stamp `reply`, emit the runlog `trace` record,
-        and feed the per-span histograms."""
-        m = self.metrics
-        if m is not None:
-            m.counter("serve_requests_total")
-            if t.error is not None:
-                m.counter("serve_request_errors")
-        if t.trace is None:
-            return
-        spans = self.store.last_spans
-        if t.error is None and spans is not None:
-            t.trace.spans.update(spans)
-        t.trace.stamp("reply")
-        if m is not None:
-            s = t.trace.spans
-            segs = (
-                ("serve_span_queue_ms", "submit", "batch_admit"),
-                ("serve_span_device_ms", "dispatch", "device_compute"),
-                ("serve_span_scatter_ms", "device_compute",
-                 "scatter_back"),
-                ("serve_span_total_ms", "submit", "reply"),
-            )
-            for name, a, b in segs:
-                if a in s and b in s:
-                    m.observe(name, (s[b] - s[a]) * 1e3)
-        if self.runlog is not None:
-            self.runlog.trace(
-                t.trace.trace_id, t.trace.offsets_ms(),
-                session_id=t.session_id,
-                error=None if t.error is None
-                else type(t.error).__name__,
-            )
+        _finish_ticket(t, self.store, self.metrics, self.runlog)
 
     def flush(self, reason: str = "forced") -> None:
         """Serve every pending ticket. Duplicate session ids in one
@@ -581,6 +806,176 @@ class MicroBatcher:
                 self._finish(t)
 
 
+class ContinuousBatcher:
+    """Iteration-level (continuous) batching front over a
+    `SessionStore` — the ISSUE-13 replacement for the fixed-linger
+    window (Orca, OSDI'22, adapted to the synchronous host front).
+
+    There is NO linger timer. The width-K serving slot re-fills from
+    the queue the moment the previous compiled call returns: `submit`
+    enqueues (dispatching immediately when K distinct sessions are
+    ready — a full slot never waits), and each `poll()`/`pump()`
+    serves ONE batch of whatever is queued — partial fills are free
+    because the compiled program drops padding lanes (`mode="drop"`),
+    so under-filled batches cost exactly their occupants. While a
+    compiled call runs, new arrivals queue; the next pump admits them
+    — occupancy-driven batching with no timer to tune.
+
+    Fairness: one FIFO queue per session (the loadgen's tenant unit),
+    with ADMISSION-ORDER round-robin rotation across sessions — a
+    session joins the rotation tail when its queue becomes non-empty
+    and re-joins the tail after each admission while backlogged.
+    Structural no-starvation bound (test-pinned): with S backlogged
+    sessions and batch width K, every queue-head request is admitted
+    within ceil(S/K) pumps — no tenant's flood can starve another,
+    and duplicate-session requests are sequential by construction
+    (one per batch, FIFO within the session).
+
+    Quarantine eviction mid-stream: when a served decision trips the
+    health sentinel (or a queued session turns out quarantined /
+    closed at dispatch), the session's REMAINING queued tickets are
+    evicted — each fails with `SessionQuarantined` (or the dispatch
+    error) instead of riding later batches — while co-queued sessions
+    are unaffected.
+
+    Instrumentation mirrors `MicroBatcher` (shared `_finish_ticket`):
+    flush reasons are `size` (a full slot dispatched at submit),
+    `occupancy` (a pump dispatched a partial slot) and `forced`
+    (drain); waits land in `serve_queue_wait_ms` (there is no linger
+    to wait out)."""
+
+    front_name = "continuous"
+
+    def __init__(self, store: SessionStore, *, metrics=None,
+                 runlog=None, trace: bool = False) -> None:
+        self.store = store
+        self.metrics = metrics
+        self.runlog = runlog
+        self.trace = bool(trace)
+        self._queues: dict[int, deque[Ticket]] = {}
+        self._rotation: deque[int] = deque()
+
+    def submit(self, sid: int) -> Ticket:
+        t = Ticket(sid, traced=self.trace)
+        q = self._queues.get(sid)
+        if q is None:
+            q = self._queues[sid] = deque()
+        if not q:
+            self._rotation.append(sid)
+        q.append(t)
+        # occupancy-driven dispatch: a full width-K slot never waits
+        if len(self._rotation) >= self.store.max_batch:
+            self.pump(reason="size")
+        return t
+
+    @property
+    def pending(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def poll(self) -> bool:
+        """Serve one batch if anything is queued; True when one ran.
+        The drivers' poll loop IS the continuous-batching engine: each
+        call re-fills the serving slot with whatever arrived while the
+        previous compiled call was in flight."""
+        return self.pump(reason="occupancy")
+
+    def flush(self) -> None:
+        """Drain the whole queue (end-of-schedule / shutdown)."""
+        while self._rotation:
+            self.pump(reason="forced")
+
+    def _finish(self, t: Ticket) -> None:
+        _finish_ticket(t, self.store, self.metrics, self.runlog)
+
+    def _evict_unservable(self, batch: list[Ticket]) -> None:
+        """Mid-stream eviction: any batch member whose decision
+        tripped the sentinel — or whose dispatch failed because the
+        session is quarantined or closed — drags its queued followers
+        out: each fails its own ticket NOW (with the same error
+        class) instead of burning later batch lanes on a session that
+        will never be served again. A closed session's backlog
+        otherwise degrades N later pumps to the one-by-one exception
+        fallback, serializing innocent co-riders."""
+        for t in batch:
+            if isinstance(t.error, (SessionQuarantined, SessionError)):
+                fail: type[Exception] = type(t.error)
+            elif t.result is not None and t.result.health_mask != 0:
+                fail = SessionQuarantined
+            else:
+                continue
+            sid = t.session_id
+            q = self._queues.pop(sid, None)
+            if sid in self._rotation:
+                self._rotation.remove(sid)
+            while q:
+                tk = q.popleft()
+                tk.error = fail(
+                    f"session {sid} unservable mid-stream "
+                    f"({fail.__name__}); queued request evicted"
+                )
+                self._finish(tk)
+
+    def pump(self, reason: str = "occupancy") -> bool:
+        """Admit up to `max_batch` queue heads (round-robin over the
+        session rotation) and serve them in ONE compiled call; True
+        when a batch ran."""
+        if not self._rotation:
+            return False
+        m = self.metrics
+        if m is not None:
+            m.counter(f"serve_flush_{reason}")
+            m.observe("serve_queue_depth", self.pending)
+        batch: list[Ticket] = []
+        for _ in range(min(self.store.max_batch,
+                           len(self._rotation))):
+            sid = self._rotation.popleft()
+            batch.append(self._queues[sid].popleft())
+        # backlogged sessions re-join the rotation TAIL in admission
+        # order — the round-robin step of the fairness bound
+        for t in batch:
+            if self._queues[t.session_id]:
+                self._rotation.append(t.session_id)
+            else:
+                del self._queues[t.session_id]
+        now = time.perf_counter()
+        for t in batch:
+            if m is not None:
+                m.observe(
+                    "serve_queue_wait_ms",
+                    (now - t.submitted_at) * 1e3,
+                )
+            if t.trace is not None:
+                t.trace.stamp("batch_admit", now)
+        if m is not None:
+            m.observe("serve_batch_occupancy", len(batch))
+        try:
+            if self.trace:
+                with annotate("serve/flush"):
+                    results = self.store.decide_batch(
+                        [t.session_id for t in batch]
+                    )
+            else:
+                results = self.store.decide_batch(
+                    [t.session_id for t in batch]
+                )
+        except Exception:
+            # a bad session id poisons the whole batch call; re-serve
+            # one by one so only the offender fails its ticket
+            for t in batch:
+                try:
+                    t.result = self.store.decide(t.session_id)
+                except Exception as e:
+                    t.error = e
+                self._finish(t)
+            self._evict_unservable(batch)
+            return True
+        for t, r in zip(batch, results):
+            t.result = r
+            self._finish(t)
+        self._evict_unservable(batch)
+        return True
+
+
 def store_from_config(
     cfg: dict[str, Any] | None,
     params: EnvParams,
@@ -591,8 +986,8 @@ def store_from_config(
     """Build a `SessionStore` from a top-level `serve:` YAML block.
     Unknown keys fail loudly (the `health:`/`chaos:` block contract —
     config.SERVE_KEYS is the single source of truth for the surface).
-    Returns the store; `linger_ms` is consumed by the caller building
-    a `MicroBatcher` (it is a front knob, not a store knob)."""
+    Returns the store; `front`/`linger_ms` are FRONT knobs consumed by
+    `front_from_config` (build the batcher there, not here)."""
     cfg = dict(cfg or {})
     unknown = set(cfg) - set(SERVE_KEYS)
     if unknown:
@@ -612,9 +1007,40 @@ def store_from_config(
         # via overrides)
         "trace": bool(cfg.get("trace", False)),
     }
+    # ISSUE 13: the pager (device slots < sessions) and the dp-sharded
+    # store; both default off so an r11 block builds an r11 store
+    if cfg.get("hot_capacity") is not None:
+        kw["hot_capacity"] = int(cfg["hot_capacity"])
+    if cfg.get("shard_dp"):
+        from ..parallel import mesh_from_config
+
+        kw["mesh"] = mesh_from_config({"dp": cfg["shard_dp"]})
     if cfg.get("metrics", False):
         from ..obs.metrics import MetricsRegistry
 
         kw["metrics"] = MetricsRegistry()
     kw.update(overrides)
     return SessionStore(params, bank, scheduler, **kw)
+
+
+def front_from_config(
+    cfg: dict[str, Any] | None,
+    store: SessionStore,
+    **overrides: Any,
+) -> "ContinuousBatcher | MicroBatcher":
+    """Build the batching front the `serve:` block names:
+    `front: continuous` (the ISSUE-13 default) or `front: linger`
+    (the r10/r11 fixed-linger `MicroBatcher`, kept for A/B runs —
+    `linger_ms` applies to it alone). Unknown fronts fail loudly."""
+    cfg = dict(cfg or {})
+    front = str(cfg.get("front", "continuous"))
+    if front == "continuous":
+        return ContinuousBatcher(store, **overrides)
+    if front == "linger":
+        return MicroBatcher(
+            store, linger_ms=float(cfg.get("linger_ms", 1.0)),
+            **overrides,
+        )
+    raise ValueError(
+        f"unknown serve front {front!r}; known: continuous, linger"
+    )

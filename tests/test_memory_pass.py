@@ -366,3 +366,62 @@ def test_lane_fit_linear_model_matches_direct_trace(bank):
     direct = jaxpr_memory_estimate(_trace_vmapped(fn, args, 64))
     est = fit["candidates"][0]["est_peak_bytes"]
     assert est == direct["peak_lower_bound_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: the hot-set capacity model behind the session pager
+# ---------------------------------------------------------------------------
+
+
+def test_hot_set_fit_monotone_in_hot_capacity():
+    """`hot_set_fit` (the lane-fit advisor's serving analog) must be
+    MONOTONE in hot capacity: estimated bytes nondecreasing, `fits`
+    antitone, and `max_hot_fit` exactly the largest fitting candidate
+    — the pager sizes the device store off these predictions, so a
+    non-monotone model could report a larger hot set as cheaper than
+    a smaller one. Also pins the fixed-cost shift (a bigger replicated
+    bank never increases the fitting hot set) and the per-device dp
+    mode (sharding the [H] axis over dp chips fits at least as many
+    GLOBAL slots as one chip does)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparksched_tpu.obs.memory import hot_set_fit
+
+    slot = {
+        "env": jax.ShapeDtypeStruct((154, 20, 8), jnp.float32),
+        "adj": jax.ShapeDtypeStruct((20, 20, 20), jnp.bool_),
+        "mode": jax.ShapeDtypeStruct((), jnp.int32),
+    }
+    cands = (8, 16, 32, 64, 128, 256)
+    budget = 2 * 10**9
+    fit = hot_set_fit(slot, candidates=cands, budget_bytes=budget)
+    ests = [c["est_bytes"] for c in fit["candidates"]]
+    fits = [c["fits"] for c in fit["candidates"]]
+    assert [c["hot"] for c in fit["candidates"]] == sorted(cands)
+    assert ests == sorted(ests), "est bytes must be nondecreasing"
+    # fits is a prefix: once a hot set misses the budget, every larger
+    # one does too
+    assert fits == sorted(fits, reverse=True)
+    fitting = [c["hot"] for c in fit["candidates"] if c["fits"]]
+    assert fit["max_hot_fit"] == (max(fitting) if fitting else 0)
+    assert fit["slot_bytes"] > 0
+
+    # fixed cost shifts the whole curve up — never down
+    heavier = hot_set_fit(
+        slot, candidates=cands, budget_bytes=budget,
+        fixed_bytes=10**9,
+    )
+    for a, b in zip(fit["candidates"], heavier["candidates"]):
+        assert b["est_bytes"] == a["est_bytes"] + 10**9
+    assert heavier["max_hot_fit"] <= fit["max_hot_fit"]
+
+    # dp mode: each chip holds ceil(H/dp) slots, so the same global
+    # candidates cost per-device no more than single-chip
+    dp2 = hot_set_fit(
+        slot, candidates=cands, budget_bytes=budget, dp=2
+    )
+    for a, b in zip(fit["candidates"], dp2["candidates"]):
+        assert b["hot_per_device"] == -(-a["hot"] // 2)
+        assert b["est_bytes"] <= a["est_bytes"]
+    assert dp2["max_hot_fit"] >= fit["max_hot_fit"]

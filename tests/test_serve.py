@@ -1,10 +1,14 @@
-"""AOT decision serving (sparksched_tpu/serve, ISSUE 10): AOT-vs-jit
-step-exactness, donated-buffer aliasing, the warm-path zero-recompile
-pin, session lifecycle + health quarantine, and the micro-batching
-front. Shapes are tiny (6-job cap, capacity 6) — the serve programs
-are shape-polymorphic and the production store differs only in buffer
-widths — and the expensive compiles are amortized behind module-scoped
-fixtures."""
+"""AOT decision serving (sparksched_tpu/serve, ISSUE 10/13): AOT-vs-
+jit step-exactness, donated-buffer aliasing, the warm-path
+zero-recompile pin, session lifecycle + health quarantine, both
+batching fronts (the fixed-linger `MicroBatcher` and the ISSUE-13
+`ContinuousBatcher` — fairness, starvation bound, quarantine
+eviction), the hot/cold pager (bit-exact page round-trip + full
+decision parity vs an unpaged store), and the dp-sharded store
+(decision parity vs the unsharded layout). Shapes are tiny (6-job
+cap, capacity 6) — the serve programs are shape-polymorphic and the
+production store differs only in buffer widths — and the expensive
+compiles are amortized behind module-scoped fixtures."""
 
 from __future__ import annotations
 
@@ -15,10 +19,11 @@ import pytest
 
 from sparksched_tpu.config import EnvParams
 from sparksched_tpu.env import core
-from sparksched_tpu.env.flat_loop import init_loop_state
+from sparksched_tpu.env.flat_loop import init_loop_state, take_slot
 from sparksched_tpu.env.health import H_NONFINITE_TIME
 from sparksched_tpu.schedulers import DecimaScheduler
 from sparksched_tpu.serve import (
+    ContinuousBatcher,
     MicroBatcher,
     SessionError,
     SessionQuarantined,
@@ -509,6 +514,243 @@ def test_run_open_loop_resolves_every_request(store):
     assert reg.counters["serve_requests_total"] == 24
     # the run closed its tenant sessions behind itself
     assert store.stats["serve_sessions_live"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: the continuous batcher — occupancy dispatch, admission-
+# order fairness, the starvation bound, decision parity vs the
+# single-session path, quarantined-lane eviction mid-stream
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_batcher_occupancy_and_decide_parity(store):
+    """The continuous front has NO linger timer: a full width-K slot
+    dispatches at submit, a partial slot dispatches on the next poll
+    (occupancy-driven — padding lanes are free), and its batched
+    decisions agree with the single-session `decide` path for
+    same-seed sessions (greedy serving)."""
+    x = store.create(seed=42)
+    y = store.create(seed=42)
+    z = store.create(seed=43)
+    r_direct = store.decide(x)
+
+    cb = ContinuousBatcher(store)
+    ty, tz = cb.submit(y), cb.submit(z)
+    assert not ty.ready and not tz.ready  # 2 sessions < K=3: queued
+    assert cb.poll()  # occupancy dispatch: no timer to wait out
+    assert ty.ready and tz.ready
+    assert ty.result.batched and tz.result.batched
+    # same state, greedy policy => same decision across paths
+    assert (ty.result.stage_idx, ty.result.num_exec) == (
+        r_direct.stage_idx, r_direct.num_exec
+    )
+    assert not cb.poll()  # empty queue: nothing to pump
+
+    # a full width-K slot never waits for a poll
+    tx, ty2, tz2 = cb.submit(x), cb.submit(y), cb.submit(z)
+    assert tx.ready and ty2.ready and tz2.ready
+    for s in (x, y, z):
+        store.close(s)
+
+
+def test_continuous_batcher_fairness_and_starvation_bound(store):
+    """Per-tenant FIFO + round-robin admission (ISSUE 13): one
+    tenant's flood cannot starve another — a newly backlogged tenant
+    is admitted on the FIRST pump after its submit (the structural
+    ceil(S/K) bound at S <= K+1), and the flooding tenant's own
+    requests resolve in FIFO order (wall clock nondecreasing)."""
+    a = store.create(seed=500)
+    b = store.create(seed=501)
+    c = store.create(seed=502)
+    d = store.create(seed=503)
+    cb = ContinuousBatcher(store)
+    ta = [cb.submit(a) for _ in range(4)]  # a floods: 4 queued
+    assert not any(t.ready for t in ta)  # one session: width-1 slot
+    tb = cb.submit(b)
+    tc = cb.submit(c)  # 3 distinct sessions ready == K: size dispatch
+    assert ta[0].ready and tb.ready and tc.ready
+    assert not ta[1].ready  # a's flood rides successive batches
+    td = cb.submit(d)
+    assert cb.pump()
+    # the starvation bound: d admitted on the first pump after its
+    # submit, co-riding with a's backlog instead of waiting it out
+    assert td.ready and td.error is None
+    assert ta[1].ready  # round-robin admitted a's next request too
+    cb.flush()
+    assert all(t.ready and t.error is None for t in ta)
+    # per-tenant FIFO: two decisions for one session are sequential
+    walls = [t.result.wall_time for t in ta]
+    assert walls == sorted(walls)
+    for s in (a, b, c, d):
+        store.close(s)
+
+
+def test_continuous_batcher_quarantine_eviction_midstream(store):
+    """A session whose decision trips the health sentinel mid-stream
+    is EVICTED from the continuous front: its queued followers fail
+    their own tickets with `SessionQuarantined` immediately (no later
+    batch lane burned on a session that will never be served again),
+    while co-queued tenants are unaffected; a later submit of the
+    quarantined session fails at dispatch."""
+    bad = store.create(seed=510)
+    good = store.create(seed=511)
+    # poison the persistent per-job completion clock with NaN — the
+    # H_NONFINITE_TIME class a corrupted device buffer would show
+    env = store._store.env
+    store._store = store._store.replace(
+        env=env.replace(
+            job_t_completed=env.job_t_completed.at[bad].set(jnp.nan)
+        )
+    )
+    cb = ContinuousBatcher(store)
+    t1, t2 = cb.submit(bad), cb.submit(bad)
+    tg = cb.submit(good)
+    assert cb.pump()  # serves [bad, good]; bad's mask trips
+    assert t1.ready and t1.error is None
+    assert t1.result.health_mask != 0
+    # mid-stream eviction: the follower fails NOW, in the same pump
+    assert t2.ready and isinstance(t2.error, SessionQuarantined)
+    assert tg.ready and tg.error is None and tg.result.decided
+    assert cb.pending == 0
+    # a post-quarantine submit fails at dispatch, ticket-local
+    t3 = cb.submit(bad)
+    cb.flush()
+    assert isinstance(t3.error, SessionQuarantined)
+    store.close(bad)
+
+    # a CLOSED session's backlog is evicted the same way (one dispatch
+    # failure fails the whole queue with SessionError, instead of N
+    # later pumps each degrading co-riders to the one-by-one fallback)
+    gone_tickets = [cb.submit(good) for _ in range(3)]
+    store.close(good)
+    assert cb.pump()
+    assert all(
+        isinstance(t.error, SessionError) for t in gone_tickets
+    )
+    assert cb.pending == 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: the hot/cold pager and the dp-sharded store
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def plain6(setup):
+    """An unpaged, unsharded capacity-6 store — the parity twin the
+    pager and sharding tests compare against (each test aligns
+    `_calls` so both stores draw the same fold_in key sequence)."""
+    params, bank, sched = setup
+    return SessionStore(
+        params, bank, sched, capacity=6, max_batch=3, seed=0
+    )
+
+
+def test_paged_store_roundtrip_bitexact_and_parity(setup, plain6):
+    """The hot/cold pager (ISSUE 13): 6 sessions over 3 device slots.
+    (a) page-out -> page-in is BIT-exact on the full LoopState (the
+    host copy is the same `take_slot` view the serve programs gather);
+    (b) a fully paged serving sequence is decision-for-decision
+    IDENTICAL to an unpaged store at the same seeds (rewards, dt and
+    wall clock included) — paging is pure placement, never semantics;
+    (c) `create` stays O(1) via the maintained free-lists and close
+    recycles ids without a scan."""
+    params, bank, sched = setup
+    paged = SessionStore(
+        params, bank, sched, capacity=6, hot_capacity=3, max_batch=3,
+        seed=0,
+    )
+    # align the fold_in counters so both stores draw identical keys
+    plain6._calls = paged._calls
+    sp = [paged.create(seed=600 + i) for i in range(6)]
+    su = [plain6.create(seed=600 + i) for i in range(6)]
+    assert paged.stats["serve_page_outs"] >= 3  # creation overflowed
+
+    # (a) bit-exact round trip for a currently-cold session
+    cold = next(s for s in sp if int(paged._slot_of[s]) < 0)
+    before = jax.tree_util.tree_leaves(paged._cold[cold])
+    [slot] = paged._ensure_hot([cold])
+    after = jax.tree_util.tree_leaves(
+        jax.device_get(take_slot(paged._store, slot))
+    )
+    for x, y in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # (b) decision parity under heavy page traffic: round-robin twice
+    # over all 6 sessions (every decide pages someone in), plus one
+    # batched call — every field equal, floats bit-for-bit
+    for rnd in range(2):
+        for i in range(6):
+            rp = paged.decide(sp[i])
+            ru = plain6.decide(su[i])
+            dp_, du = rp.to_dict(), ru.to_dict()
+            dp_.pop("session_id"), du.pop("session_id")
+            assert dp_ == du, (i, rnd, dp_, du)
+    for rp, ru in zip(
+        paged.decide_batch(sp[:3]), plain6.decide_batch(su[:3])
+    ):
+        dp_, du = rp.to_dict(), ru.to_dict()
+        dp_.pop("session_id"), du.pop("session_id")
+        assert dp_ == du
+    assert paged.stats["serve_page_ins"] > 0
+    assert paged.stats["serve_sessions_hot"] == 3
+
+    # (c) O(1) create: the free-lists recycle a closed id without a
+    # scan, and capacity exhaustion still rejects loudly
+    paged.close(sp[2])
+    assert paged.create(seed=700) == sp[2]  # LIFO free-list reuse
+    with pytest.raises(RuntimeError, match="store full"):
+        paged.create()
+    for s in sp:
+        paged.close(s)
+    for s in su:
+        plain6.close(s)
+
+
+def test_sharded_store_decision_parity(setup, plain6):
+    """The dp-sharded store (ISSUE 13): the [C] session stack sharded
+    P('dp') over a 2-device mesh serves the SAME decisions as the
+    unsharded r11 layout at the same seeds — sessions are
+    embarrassingly parallel, so sharding is placement, not semantics.
+    Decision fields are pinned exactly; float accumulations to within
+    reduction-order tolerance. The store's leaves must actually live
+    on 2 devices (a silent single-device fallback would make this
+    test vacuous), and donation must still hold."""
+    from sparksched_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 (virtual) devices")
+    params, bank, sched = setup
+    mesh = make_mesh(2)
+    sharded = SessionStore(
+        params, bank, sched, capacity=6, max_batch=3, seed=0,
+        mesh=mesh,
+    )
+    assert len(
+        sharded._store.env.wall_time.sharding.device_set
+    ) == 2
+    plain6._calls = sharded._calls
+    ss = [sharded.create(seed=800 + i) for i in range(3)]
+    su = [plain6.create(seed=800 + i) for i in range(3)]
+    for rnd in range(2):
+        rs = sharded.decide_batch(ss)
+        ru = plain6.decide_batch(su)
+        for x, y in zip(rs, ru):
+            dx, dy = x.to_dict(), y.to_dict()
+            for k in ("stage_idx", "num_exec", "job_idx", "decided",
+                      "done", "health_mask"):
+                assert dx[k] == dy[k], (k, dx, dy)
+            for k in ("reward", "dt", "wall_time", "lgprob"):
+                np.testing.assert_allclose(
+                    dx[k], dy[k], rtol=1e-5, atol=1e-6, err_msg=k
+                )
+    # the single-session path on the sharded layout too
+    r1, r2 = sharded.decide(ss[0]), plain6.decide(su[0])
+    assert (r1.stage_idx, r1.num_exec) == (r2.stage_idx, r2.num_exec)
+    for s in ss:
+        sharded.close(s)
+    for s in su:
+        plain6.close(s)
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,10 @@ def test_shipped_tree_is_analysis_clean():
         # ISSUE 10: the AOT decision-serving programs (serve/aot.py),
         # audited exactly as the session store lowers them
         "serve_decide", "serve_decide_batch",
+        # ISSUE 13: the dp-sharded store variant (the sharding
+        # constraints are part of the traced program, so the audited
+        # jaxpr IS the sharded configuration)
+        "serve_decide_batch_sharded",
     }
     assert set(report["passes"]["jaxpr"]["measured"]) == all_programs
     mem = report["passes"]["memory"]["measured"]
