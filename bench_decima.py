@@ -387,6 +387,24 @@ def _on_chip_block() -> dict:
     return {"device_memory": stats}
 
 
+# the serving benches' Decima architecture — ONE definition shared by
+# `_serve_setup` (the scheduler the store compiles) and the online
+# arm's learner trainer (ISSUE 14), which MUST build the same net or a
+# publish would be rejected at `set_params`'s aval check (shape drift)
+# or silently train a mismatched policy (same shapes, different
+# activation). job_bucket 16 is the PR-3 CPU calibration winner.
+SERVE_AGENT_KWARGS = {
+    "embed_dim": 16,
+    "gnn_mlp_kwargs": {
+        "hid_dims": [32, 16],
+        "act_cls": "LeakyReLU",
+        "act_kwargs": {"negative_slope": 0.2},
+    },
+    "policy_mlp_kwargs": {"hid_dims": [64, 64], "act_cls": "Tanh"},
+    "job_bucket": 16,
+}
+
+
 def _serve_setup():
     """(params, bank, sched) for the serving benches — the BASELINE.md
     config #3 env at the PR-3 CPU-calibrated compaction bucket, shared
@@ -403,15 +421,7 @@ def _serve_setup():
             max_stages=bank.max_stages, max_levels=bank.max_stages
         )
     sched = DecimaScheduler(
-        num_executors=params.num_executors,
-        embed_dim=16,
-        gnn_mlp_kwargs={
-            "hid_dims": [32, 16],
-            "act_cls": "LeakyReLU",
-            "act_kwargs": {"negative_slope": 0.2},
-        },
-        policy_mlp_kwargs={"hid_dims": [64, 64], "act_cls": "Tanh"},
-        job_bucket=16,  # the PR-3 CPU calibration winner
+        num_executors=params.num_executors, **SERVE_AGENT_KWARGS
     )
     return params, bank, sched
 
@@ -657,7 +667,7 @@ def _serve_obs_overhead(store, reps: int = 30) -> dict:
 
 
 def bench_serve_scale(
-    artifact: str = "artifacts/serve_scale_r13.json",
+    artifact: str = "artifacts/serve_scale_r16.json",
 ) -> list[dict]:
     """Serving at load (ISSUE 11/13): open-loop offered-load sweep
     over the AOT session store, reporting GOODPUT under a p99 SLO —
@@ -680,7 +690,18 @@ def bench_serve_scale(
     HBM budget holds, the pager's sizing model). Arrival schedules
     are seeded and deterministic (serve/loadgen.py); latency is
     measured open-loop, so offered loads beyond capacity show the
-    queueing tail closed-loop medians can never see."""
+    queueing tail closed-loop medians can never see.
+
+    Since round 16 (ISSUE 14) the bench grows an ONLINE arm
+    (`SERVE_SCALE_ONLINE=1`, the default): one extra point at
+    `SERVE_SCALE_ONLINE_RPS` runs the full closed loop — a record-on
+    store serving the seeded schedule while a background
+    `OnlineLearner` drains served-decision trajectories through
+    `ppo_update` and hot-swaps accepted versions in via the `ParamBus`
+    (zero recompiles) — so the artifact reports goodput@SLO AND the
+    reward trend under live learning, plus the record-on-vs-off
+    serving overhead at the same offered load (interleaved
+    run-granularity A/B against the bench's record-off store)."""
     offered = [
         float(x) for x in os.environ.get(
             "SERVE_SCALE_OFFERED", "12.5,25,50,100,200"
@@ -715,6 +736,7 @@ def bench_serve_scale(
     from sparksched_tpu.obs.metrics import (
         MetricsRegistry,
         hist_summary,
+        paired_ab_pct,
         percentile_block,
     )
     from sparksched_tpu.obs.runlog import RunLog
@@ -913,6 +935,200 @@ def bench_serve_scale(
             runlog.metrics(snap, metric=row["metric"])
             print(json.dumps(row), flush=True)
 
+    # ---- the online arm (ISSUE 14): the closed serve->learn->serve
+    # loop at one offered-load point — goodput@SLO + reward trend
+    # under live learning, hot-swap accounting, and the record-on
+    # serving-overhead A/B at the same offered load
+    online_protocol = None
+    if os.environ.get("SERVE_SCALE_ONLINE", "1") == "1":
+        from sparksched_tpu.online import online_from_config
+
+        on_rate = float(os.environ.get(
+            "SERVE_SCALE_ONLINE_RPS",
+            offered[len(offered) // 2] if offered else 25.0,
+        ))
+        # the learner's trainer builds the SAME net the serving
+        # scheduler runs (the swap publishes into the compiled
+        # programs) — one shared definition, never a copy
+        agent_cfg = {"agent_cls": "DecimaScheduler"} | SERVE_AGENT_KWARGS
+        reg = MetricsRegistry()
+        t0o = time.perf_counter()
+        store_on = SessionStore(
+            params, bank, sched, capacity=capacity,
+            hot_capacity=hot_capacity, max_batch=max_batch,
+            deterministic=True, seed=0, runlog=runlog, metrics=reg,
+            record=True,
+        )
+        online_cold_s = time.perf_counter() - t0o
+        buffer, learner, bus = online_from_config(
+            {
+                "max_steps": 16, "batch_trajectories": 4,
+                "probation_decisions": 32,
+                "max_quarantine_rate": 0.5,
+            },
+            store_on, agent_cfg, runlog=runlog, metrics=reg,
+        )
+        learner_compile_s = learner.warmup()
+        # absorb first-dispatch glue + prime the trajectory buffer
+        # outside the measured window
+        warm = generate_arrivals(
+            on_rate, max(2 * tenants, 24), tenants, seed=seed + 3
+        )
+        run_open_loop(
+            store_on, ContinuousBatcher(store_on, metrics=reg), warm,
+            slo_ms=slo_ms, session_seed=41_000, on_poll=bus.pump,
+            keep_samples=False,
+        )
+        while learner.ready():
+            learner.step()
+        bus.pump()
+        v0 = store_on.params_version
+        swaps0 = store_on.stats["serve_param_swaps"]
+        steps0 = learner.stats["learner_steps"]
+        arrivals = generate_arrivals(
+            on_rate, n_req, tenants, seed=seed
+        )
+        front_on = ContinuousBatcher(
+            store_on, metrics=reg, runlog=runlog, trace=True
+        )
+        store_on.trace = True
+        learner.start_background()
+        try:
+            summary = run_open_loop(
+                store_on, front_on, arrivals, slo_ms=slo_ms,
+                session_seed=42_000, on_poll=bus.pump,
+            )
+        finally:
+            learner.stop()
+            store_on.trace = False
+        # snapshot the IN-WINDOW accounting BEFORE the drain pump: a
+        # swap published at the window's tail but applied by the pump
+        # below landed outside the measured traffic
+        swaps_in_window = (
+            store_on.stats["serve_param_swaps"] - swaps0
+        )
+        steps_in_window = learner.stats["learner_steps"] - steps0
+        bus.pump()
+        samples = summary.pop("samples_ms")
+        hist_on = summary.pop("hist")
+        lat_block = percentile_block(samples)
+
+        # record-on vs record-off at the SAME offered load: the off
+        # arm is the bench's record-off store, arms interleaved
+        # rep-by-rep (run-granularity interleaved_ab), medians of the
+        # per-rep mean latency compared. BOTH arms run bare — no
+        # metrics, no trace, no collector — so the A/B isolates the
+        # record PATH's serving cost (trajectory assembly is the
+        # loop's cost, measured by the window above, not here)
+        store.metrics, store.trace = None, False
+        on_state = (store_on.metrics, store_on.collector)
+        store_on.metrics, store_on.collector = None, None
+        ab_sched = generate_arrivals(
+            on_rate, max(n_req // 2, 60), tenants, seed=seed + 4
+        )
+        rec_runs: dict[str, list[float]] = {"off": [], "on": []}
+        for rep in range(max(1, ab_reps)):
+            arms = (("off", store), ("on", store_on))
+            if rep % 2:
+                arms = arms[::-1]  # cancel within-pair ordering bias
+            for label, st in arms:
+                s2 = run_open_loop(
+                    st, ContinuousBatcher(st), ab_sched,
+                    slo_ms=slo_ms, session_seed=43_000,
+                )
+                rec_runs[label].append(
+                    percentile_block(s2["samples_ms"])["mean_ms"]
+                )
+        store_on.metrics, store_on.collector = on_state
+        rec_med = {
+            k: sorted(v)[len(v) // 2] for k, v in rec_runs.items()
+        }
+        # paired per-rep statistic: run-level reps are few and box
+        # drift is monotone — pairing cancels it
+        # (obs.metrics.paired_ab_pct)
+        rec_pct = paired_ab_pct(rec_runs["off"], rec_runs["on"])
+        reward_trend = [
+            {
+                "version": h.get("version"),
+                "policy_loss": round(h["policy_loss"], 6),
+                "traj_reward_mean": round(h["traj_reward_mean"], 2),
+                "accepted": h["accepted"],
+            }
+            for h in learner.history
+        ]
+        online_block = {
+            "hot_swaps": store_on.stats["serve_param_swaps"],
+            "swaps_in_window": swaps_in_window,
+            "params_version": {
+                "start": v0, "end": store_on.params_version,
+            },
+            "rollbacks": store_on.stats["serve_param_rollbacks"],
+            "learner_steps": learner.stats["learner_steps"],
+            "learner_steps_in_window": steps_in_window,
+            "learner_rejected": learner.stats["learner_rejected"],
+            "reward_trend": reward_trend,
+            "trajectories": dict(buffer.stats),
+            "bus": dict(bus.stats),
+        }
+        row = {
+            "metric": f"serve_scale_online{on_rate:g}rps",
+            "value": summary["goodput_rps"],
+            "unit": "decisions/s",
+            "slo": {
+                "p99_slo_ms": slo_ms,
+                "p99_ms": lat_block["p99_ms"],
+                "slo_met": lat_block["p99_ms"] <= slo_ms,
+                "good": summary["good"],
+                "goodput_rps": summary["goodput_rps"],
+            },
+            "open_loop": {
+                k: summary[k] for k in (
+                    "requests", "front", "completed", "errors",
+                    "makespan_s", "offered_rps", "achieved_rps",
+                    "session_rotations", "capacity_rejections",
+                )
+            },
+            "latency": lat_block | {"hist": hist_summary(hist_on)},
+            "online": online_block,
+            "record_overhead": {
+                "open_loop_pct": round(rec_pct, 2),
+                "mean_ms": {
+                    "off": round(rec_med["off"], 3),
+                    "on": round(rec_med["on"], 3),
+                },
+                "reps": rec_runs,
+                "passed": rec_pct <= 5.0,
+                "bar_pct": 5.0,
+            },
+            "analysis_clean": analysis_clean_stamp(),
+            "config": base_cfg | {
+                "offered_rps": on_rate, "process": "poisson",
+                "front": "continuous", "record": True,
+                "online_cold_start_s": round(online_cold_s, 3),
+                "learner_compile_s": round(learner_compile_s, 3),
+            },
+            "on_chip": _on_chip_block(),
+        }
+        rows.append(row)
+        runlog.metrics(reg.snapshot(), metric=row["metric"])
+        print(json.dumps(row), flush=True)
+        online_protocol = {
+            "loop": "record-on store + ContinuousBatcher serving the "
+                    "seeded schedule; background OnlineLearner "
+                    "(ppo_update, health gates on) publishes via "
+                    "ParamBus; swaps applied between compiled calls "
+                    "(run_open_loop on_poll) — zero recompiles by "
+                    "construction (params are arguments of the AOT "
+                    "programs; pinned in tests/test_online.py)",
+            "offered_rps": on_rate,
+            "record_ab": "record-on vs record-off store at the same "
+                         "seeded offered load, arms interleaved "
+                         "rep-by-rep, median per-rep mean latency",
+            "record_overhead_pct": round(rec_pct, 2),
+            "hot_swaps": online_block["hot_swaps"],
+            "learner_steps": online_block["learner_steps"],
+        }
+
     # the headline the A/B exists to measure: per front, the highest
     # offered (poisson) load whose MEDIAN p99 met the SLO
     sustained = {
@@ -949,6 +1165,9 @@ def bench_serve_scale(
                 "requests_per_point": n_req,
                 "offered_sweep_rps": offered,
                 "obs_overhead": overhead,
+                # ISSUE 14: the online arm's summary (None when
+                # SERVE_SCALE_ONLINE=0)
+                "online": online_protocol,
             },
             "rows": rows,
         }, fp, indent=1)

@@ -192,6 +192,24 @@ BUDGETS: dict[str, Budget] = {
     "serve_decide_batch_sharded": Budget(
         eqn_lo=6000, eqn_hi=17500, gather_hi=339, scatter_hi=88,
     ),
+    # ISSUE 14: the record-on serve variants (serve/aot.py
+    # `record=True` — the online trajectory path's programs), pinned
+    # 2026-08-04 — serve_decide_record 6520/33/65,
+    # serve_decide_batch_record 12860/251/65: +6/+7 eqns over the
+    # record-off programs (the StoredObs assembly is masked selects
+    # over already-computed observation pieces; zero extra
+    # gathers/scatters). Two things were re-measured in the same PR:
+    # (a) the record-off programs above are BYTE-IDENTICAL to the
+    # PR-10/13 pins, and (b) moving the model params from closure
+    # constants to runtime arguments (the hot-swap refactor) changed
+    # NO count on any serve program — params enter as invars, the
+    # traced computation is the same.
+    "serve_decide_record": Budget(
+        eqn_lo=3000, eqn_hi=8810, gather_hi=45, scatter_hi=88,
+    ),
+    "serve_decide_batch_record": Budget(
+        eqn_lo=6000, eqn_hi=17410, gather_hi=339, scatter_hi=88,
+    ),
 }
 
 

@@ -64,6 +64,14 @@ HOT_DIRS = ("env", "schedulers")
 HOST_FILES = frozenset({
     "renderer.py", "env/gym_compat.py", "serve/session.py",
     "serve/loadgen.py",
+    # ISSUE 14: the online loop's host-side modules — trajectory
+    # assembly consumes concrete ServeResults (device_get is the
+    # product, as in serve/session.py), the learner's host loop syncs
+    # on update completion exactly like trainers/trainer.py's, and
+    # the bus is pure host bookkeeping; their traced code is the
+    # registry-audited serve/ppo programs, not these files
+    "online/__init__.py", "online/trajectory.py",
+    "online/learner.py", "online/bus.py",
 })
 
 # host-side entry points inside otherwise-hot modules, PATH-QUALIFIED

@@ -155,6 +155,17 @@ MEM_BUDGETS: dict[str, MemBudget] = {
     # roughly double the temp bytes and breach here on CPU before a
     # multi-chip window ever compiles it.
     "serve_decide_batch_sharded": MemBudget(temp_hi=445 * MB),
+    # ISSUE 14 record-on serve variants (pinned 2026-08-04): 59.3 MB
+    # / 326.7 MB vs 59.0 / 325.5 record-off — the StoredObs record is
+    # a handful of [J,S] masks/counters per decision, ~0.4% bytes.
+    # The band pins that recording stays a byproduct of the decision
+    # already computed: a record path that re-materializes
+    # observation-sized temporaries (a second observe pass, an
+    # unmasked [J,S,S] adjacency copy) breaches here first. The
+    # record-off programs re-measured byte-identical in the same PR
+    # (the hot-swap params-as-argument refactor moved no bytes).
+    "serve_decide_record": MemBudget(temp_hi=81 * MB),
+    "serve_decide_batch_record": MemBudget(temp_hi=442 * MB),
 }
 
 # lane counts the advisor sweeps (the bench's production range; 1024

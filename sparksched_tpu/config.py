@@ -152,6 +152,31 @@ SERVE_KEYS = frozenset({
     "front",  # batching front: continuous (default) | linger
     "hot_capacity",  # device slots; < capacity pages idle sessions to host
     "shard_dp",  # shard the device store over a dp mesh (N | "auto")
+    # ISSUE 14: the online learning loop's serve-side knobs
+    "record",  # compile the record-on programs (per-decision StoredObs)
+    "pager_aware",  # continuous front: prefer hot sessions in batches
+})
+
+ONLINE_KEYS = frozenset({
+    # ISSUE 14: the top-level `online:` block — the serve->learn->serve
+    # loop's surface (sparksched_tpu/online/: TrajectoryBuffer +
+    # OnlineLearner + ParamBus, built by `online.online_from_config`),
+    # validated with the same fail-loud contract as health:/serve:
+    "enabled",  # default True when the block is present
+    "max_trajectories",  # completed-trajectory buffer bound (FIFO evict)
+    "max_steps",  # decisions per trajectory segment (the padded T)
+    "batch_trajectories",  # trajectories per ppo_update (the padded B)
+    "max_param_lag",  # off-policy guard: skip trajectories whose
+    #   params-version lag exceeds this (PPO's ratio clip covers the rest)
+    "min_decisions",  # drop segments shorter than this many decisions
+    "swap_every",  # publish params every N accepted learner updates
+    "probation_decisions",  # post-swap decisions watched before a swap
+    #   is marked good (the rollback window)
+    "max_quarantine_rate",  # rollback when the post-swap quarantine
+    #   rate over the probation window exceeds this
+    "learner",  # nested PPO-hyperparameter overrides for the learner's
+    #   trainer (lr, num_epochs, num_batches, entropy_coeff, ...)
+    "seed",
 })
 
 OBS_KEYS = frozenset({

@@ -305,6 +305,22 @@ def interleaved_ab(arm_off, arm_on, warmups: int = 2, reps: int = 5
     return t_off, t_on, 100.0 * (t_on - t_off) / t_off
 
 
+def paired_ab_pct(offs: list[float], ons: list[float]) -> float:
+    """Overhead percent from PAIRED interleaved reps: the median of
+    per-pair ratios (on_i / off_i - 1). For run-granularity A/Bs —
+    few, expensive reps — monotone box drift moves BOTH arms of a
+    pair together, so pairing cancels it, while the median-of-arms
+    form (`interleaved_ab`, right for many fast reps) aliases the
+    drift into whichever arm's median lands later. ONE implementation
+    wherever a run-level A/B bar is claimed (the record-overhead A/Bs
+    of bench_serve_scale's online arm and scripts_online_loop.py)."""
+    assert len(offs) == len(ons) and offs, (len(offs), len(ons))
+    ratios = sorted(
+        on / off - 1.0 for off, on in zip(offs, ons)
+    )
+    return 100.0 * ratios[len(ratios) // 2]
+
+
 # ---------------------------------------------------------------------------
 # shared bench quantile helpers (ISSUE 11 satellite): the latency rows'
 # percentile block — EXACT sample percentiles with the round-13 keys,

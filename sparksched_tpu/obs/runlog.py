@@ -37,6 +37,12 @@ kind) and `t` (unix seconds); the kinds the trainer/bench write:
   backoff, a checkpoint fallback past a corrupt generation, or a
   gave-up marker; `chaos` records mark deliberate fault injections
   (sparksched_tpu/chaos.py) so drills are self-describing
+- `params_swap`: a hot parameter swap into live serving (ISSUE 14) —
+  the new `version`, the `prev_version` it replaced, the `action`
+  (swap | rollback) and an optional origin/reason; written by
+  `SessionStore.set_params`/`rollback_params` so every served
+  decision's staleness stamp (`params_version` on `trace` records)
+  can be aligned with the swap history
 - `jit_compile` / `jit_compile_detail`: JIT (re)compilation events via
   `jax.monitoring` duration hooks plus the dispatch logger (the latter
   names WHICH function was traced/compiled)
@@ -269,6 +275,22 @@ class RunLog:
             spans={k: round(float(v), 4) for k, v in spans_ms.items()},
             total_ms=None if total is None else round(float(total), 4),
             **fields,
+        )
+
+    def params_swap(self, version: int, prev_version: int,
+                    action: str = "swap",
+                    reason: str | None = None,
+                    **fields: Any) -> None:
+        """One hot parameter swap into live serving (ISSUE 14):
+        versioned so staleness stamps on `trace` records and the
+        trajectory buffer resolve against the swap history. `action`
+        is `swap` (a learner publish) or `rollback` (the
+        quarantine-style revert to the last-good version)."""
+        if reason is not None:
+            fields["reason"] = reason
+        self.write(
+            "params_swap", version=int(version),
+            prev_version=int(prev_version), action=action, **fields,
         )
 
     def metrics(self, snapshot: dict[str, Any],

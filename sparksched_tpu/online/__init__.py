@@ -1,0 +1,121 @@
+"""Online learning loop: learner/actor split with hot param swap into
+live serving (ISSUE 14, ROADMAP item 3).
+
+The serve->learn->serve loop over the existing stacks, IMPALA/SEED
+style:
+
+- ACTORS are the serving sessions: a record-on `SessionStore`
+  (`serve: {record: true}`) emits each served decision's
+  (obs, action, log-prob, reward, dt) record — the training
+  collectors' `StoredObs` schema — stamped with the params version
+  live at dispatch, into the bounded `TrajectoryBuffer`
+  (per-session episode assembly, FIFO eviction, dropped counters);
+- the LEARNER (`OnlineLearner`) drains completed trajectories into
+  fixed-shape padded minibatches and reuses the PR-9 `ppo_update`
+  VERBATIM (in-JIT health gates, poisoned-minibatch skip, rollback on
+  a tripped post-update mask), with a hard params-version staleness
+  bound as the off-policy guard (PPO's ratio clip covers the rest);
+- the SWAP side (`ParamBus`) publishes accepted versions into the
+  store between compiled calls — params are runtime ARGUMENTS of the
+  AOT serve programs, so a swap is zero-recompile (runlog-pinned) —
+  with versioned `params_swap` runlog records and quarantine-style
+  rollback to the last proven version when the post-swap health-mask
+  rate spikes.
+
+Config surface: the top-level `online:` YAML block
+(`config.ONLINE_KEYS`, fail-loud like `health:`/`serve:`), built by
+`online_from_config` over a record-on store. `scripts_online_loop.py`
+is the one-process demo (loadgen traffic + background learner);
+`bench_serve_scale`'s online arm measures goodput@SLO and the reward
+trend under live learning.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..config import ONLINE_KEYS
+from .bus import ParamBus
+from .learner import OnlineLearner, make_learner_trainer
+from .trajectory import Trajectory, TrajectoryBuffer
+
+__all__ = [
+    "ParamBus",
+    "OnlineLearner",
+    "make_learner_trainer",
+    "Trajectory",
+    "TrajectoryBuffer",
+    "online_from_config",
+]
+
+
+def online_from_config(
+    cfg: dict[str, Any] | None,
+    store,
+    agent_cfg: dict[str, Any],
+    *,
+    runlog=None,
+    metrics=None,
+) -> tuple[TrajectoryBuffer, OnlineLearner, ParamBus] | None:
+    """Build the (buffer, learner, bus) triple from a top-level
+    `online:` YAML block and wire it to `store` (which must be
+    record-on — the actor path needs per-decision StoredObs records).
+    Returns None when the block says `enabled: false` (nothing is
+    wired — the store serves exactly as without the block).
+    Unknown keys fail loudly (the `health:`/`serve:` contract).
+    `agent_cfg` must describe the SAME architecture the store's
+    scheduler runs: the learner starts from the store's current
+    serving params and publishes back into the same compiled
+    programs."""
+    cfg = dict(cfg or {})
+    unknown = set(cfg) - set(ONLINE_KEYS)
+    if unknown:
+        raise ValueError(
+            f"unknown online: config key(s) {sorted(unknown)}; known "
+            f"keys: {sorted(ONLINE_KEYS)}"
+        )
+    if not cfg.get("enabled", True):
+        # `enabled: false` must actually disable the loop (the
+        # health: block's contract): no collector is attached, no
+        # learner exists, nothing can publish into the store
+        return None
+    if not getattr(store, "record", False):
+        raise ValueError(
+            "online_from_config needs a record-on store "
+            "(serve: {record: true} / SessionStore(record=True)) — "
+            "a record-off store serves no trajectory records to "
+            "learn from"
+        )
+    max_steps = int(cfg.get("max_steps", 32))
+    batch = int(cfg.get("batch_trajectories", 4))
+    buffer = TrajectoryBuffer(
+        capacity=int(cfg.get("max_trajectories", 64)),
+        max_steps=max_steps,
+        min_decisions=int(cfg.get("min_decisions", 2)),
+        metrics=metrics,
+    )
+    store.collector = buffer
+    bus = ParamBus(
+        store,
+        probation_decisions=int(cfg.get("probation_decisions", 32)),
+        max_quarantine_rate=float(
+            cfg.get("max_quarantine_rate", 0.5)
+        ),
+        runlog=runlog,
+        metrics=metrics,
+    )
+    trainer = make_learner_trainer(
+        agent_cfg, store.params, batch, max_steps,
+        learner_cfg=dict(cfg.get("learner") or {}),
+        seed=int(cfg.get("seed", 0)),
+    )
+    learner = OnlineLearner(
+        trainer, buffer, bus,
+        max_param_lag=int(cfg.get("max_param_lag", 4)),
+        swap_every=int(cfg.get("swap_every", 1)),
+        init_params=store.model_params,
+        version0=store.params_version,
+        runlog=runlog,
+        metrics=metrics,
+    )
+    return buffer, learner, bus

@@ -1,0 +1,358 @@
+"""The learner half of the online loop: PPO on served-decision
+trajectories (ISSUE 14).
+
+IMPALA/SEED-style split: actors are the serving sessions (a record-on
+`SessionStore` feeding the `TrajectoryBuffer`), the learner is a
+background loop draining completed trajectories into FIXED-SHAPE
+minibatches — each drained segment is padded and masked into the
+collector `Rollout` layout (`trainers/rollout.py`), so the PR-9
+`ppo_update` (in-JIT grad sentinels, poisoned-minibatch skip gate, KL
+early stop, remat'd GNN recompute) is reused VERBATIM via
+`PPO._update_jit`. One padded shape means ONE compiled update for the
+loop's whole lifetime; `warmup()` pre-compiles it on a zero rollout so
+the serving window's zero-recompile pin holds even with the learner
+live.
+
+Off-policy handling, two layers:
+- a HARD staleness bound (`max_param_lag`, the off-policy guard):
+  trajectories whose params-version lag vs the learner's current
+  version exceeds the bound are discarded with a counter
+  (`TrajectoryBuffer.drain`) — IMPALA corrects such lag with V-trace;
+  here serving publishes every accepted update (lag is typically 0-1),
+  so a hard bound plus layer two suffices;
+- PPO's ratio clipping downweights whatever lag remains inside the
+  bound (the stored log-probs ARE the behavior policy's, so the
+  importance ratio is exact).
+
+Health gates + rollback: the update runs with the `health:` block on,
+so a non-finite loss/grad minibatch is skipped ON DEVICE, and a
+non-zero post-update `health_mask` rejects the whole step host-side —
+the learner keeps its last-good `TrainState` (the PR-9 rollback
+pattern) and never publishes a poisoned version.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import EnvParams
+from ..env import core
+from ..env.health import RETRYABLE_MASK, describe_mask
+from ..obs.runlog import emit
+from ..trainers.ppo import PPO
+from ..trainers.rollout import Rollout, _zero_stored
+from .trajectory import Trajectory, TrajectoryBuffer
+
+# learner-trainer defaults: shorter epochs/batches than offline
+# training (online minibatches are small and frequent), the flagship
+# clip/KL settings otherwise
+_LEARNER_TRAIN_DEFAULTS: dict[str, Any] = {
+    "num_epochs": 2,
+    "num_batches": 2,
+    "clip_range": 0.2,
+    "target_kl": 0.01,
+    "entropy_coeff": 0.04,
+    "beta_discount": 5.0e-3,
+    "opt_kwargs": {"lr": 3.0e-4},
+    "max_grad_norm": 0.5,
+}
+
+
+def make_learner_trainer(
+    agent_cfg: dict[str, Any],
+    env_params: EnvParams,
+    batch_trajectories: int,
+    max_steps: int,
+    learner_cfg: dict[str, Any] | None = None,
+    seed: int = 0,
+) -> PPO:
+    """A `PPO` trainer shaped for the online learner: B =
+    `batch_trajectories` lanes as ONE baseline group (online sessions
+    run independent arrival sequences, so the critic-free baseline is
+    the cross-trajectory mean — not the sequence-matched grouping the
+    offline trainer uses), T = `max_steps` decisions, health gates ON.
+    Its `_update_jit` is the verbatim `ppo_update` program the
+    analysis registry audits; `_collect` is never called."""
+    env_cfg = {
+        k: v for k, v in dataclasses.asdict(env_params).items()
+        if v is not None
+    }
+    train_cfg = dict(_LEARNER_TRAIN_DEFAULTS)
+    train_cfg.update(learner_cfg or {})
+    if "reward_buff_cap" in train_cfg and "beta_discount" not in (
+        learner_cfg or {}
+    ):
+        # the trainer demands exactly ONE returns mode; an explicit
+        # reward_buff_cap override displaces the default discount
+        train_cfg.pop("beta_discount", None)
+    train_cfg.update({
+        "trainer_cls": "PPO",
+        "num_iterations": 1,
+        "num_sequences": 1,
+        "num_rollouts": int(batch_trajectories),
+        "rollout_steps": int(max_steps),
+        "seed": int(seed),
+        "use_tensorboard": False,
+        "checkpointing_freq": 10 ** 9,
+    })
+    return PPO(
+        dict(agent_cfg), env_cfg, train_cfg,
+        health_cfg={"enabled": True},
+    )
+
+
+class OnlineLearner:
+    """Drains the `TrajectoryBuffer`, updates, publishes to the
+    `ParamBus`. Drive it inline (`step()` between serving windows) or
+    as a background thread (`start_background()` — the IMPALA shape;
+    the bus still applies swaps on the SERVING thread, between
+    compiled calls)."""
+
+    def __init__(
+        self,
+        trainer: PPO,
+        buffer: TrajectoryBuffer,
+        bus=None,
+        *,
+        max_param_lag: int = 4,
+        swap_every: int = 1,
+        init_params=None,
+        version0: int = 0,
+        runlog=None,
+        metrics=None,
+    ) -> None:
+        self.trainer = trainer
+        self.buffer = buffer
+        self.bus = bus
+        self.max_param_lag = int(max_param_lag)
+        self.swap_every = int(swap_every)
+        self.runlog = runlog
+        self.metrics = metrics
+        self.B = trainer.num_rollouts
+        self.T = trainer.rollout_steps
+        self.state = trainer.init_state()
+        if init_params is not None:
+            # start from the SERVING parameters (one policy, two
+            # stacks), not a fresh init
+            self.state = self.state.replace(
+                params=jax.device_put(init_params)
+            )
+        # published versions continue the SERVING store's numbering
+        # (`version0` = store.params_version at wiring time), so the
+        # per-decision staleness stamps and the learner's lag
+        # arithmetic share one monotonic axis
+        self.version = int(version0)
+        self.stats = {
+            "learner_steps": 0,
+            "learner_rejected": 0,
+            "learner_published": 0,
+        }
+        self.history: list[dict[str, float]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        # the padding template: one reset state broadcast to [B] fills
+        # the Rollout's (update-unused, shape-required) final_state
+        p, bank = trainer.params_env, trainer.bank
+        state0 = core.reset(p, bank, jax.random.PRNGKey(17))
+        self._final_state = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (self.B,) + a.shape), state0
+        )
+        self._zero_obs = _zero_stored(p)
+
+    # -- rollout assembly ----------------------------------------------
+
+    def _pad_rollout(self, trajs: list[Trajectory]) -> Rollout:
+        """Pad B trajectory segments into the collector layout: [B,T]
+        per-step fields, `valid` masking real decisions, walls
+        forward-filled with each lane's final time (exactly the flat
+        collectors' padding), resets zero (segments never span an
+        auto-reset — episode ends end the segment)."""
+        B, T = self.B, self.T
+        assert len(trajs) == B, (len(trajs), B)
+        obs = jax.tree_util.tree_map(
+            lambda z: np.zeros((B, T) + z.shape, z.dtype),
+            self._zero_obs,
+        )
+        stage_idx = np.full((B, T), -1, np.int32)
+        job_idx = np.zeros((B, T), np.int32)
+        num_exec_k = np.zeros((B, T), np.int32)
+        lgprob = np.zeros((B, T), np.float32)
+        reward = np.zeros((B, T), np.float32)
+        walls = np.zeros((B, T + 1), np.float32)
+        valid = np.zeros((B, T), bool)
+        for b, tr in enumerate(trajs):
+            t = min(tr.length, T)
+            if t and tr.obs is not None:
+                obs = jax.tree_util.tree_map(
+                    lambda dst, src: _fill_lane(dst, b, t, src),
+                    obs, tr.obs,
+                )
+            stage_idx[b, :t] = tr.stage_idx[:t]
+            job_idx[b, :t] = tr.job_idx[:t]
+            num_exec_k[b, :t] = tr.num_exec_k[:t]
+            lgprob[b, :t] = tr.lgprob[:t]
+            reward[b, :t] = tr.reward[:t]
+            walls[b, : t + 1] = tr.wall_times[: t + 1]
+            walls[b, t + 1:] = tr.wall_times[t]  # forward-fill final
+            valid[b, :t] = True
+        return Rollout(
+            obs=obs,
+            stage_idx=stage_idx,
+            job_idx=job_idx,
+            num_exec_k=num_exec_k,
+            lgprob=lgprob,
+            reward=reward,
+            wall_times=walls,
+            valid=valid,
+            resets=np.zeros((B, T), bool),
+            final_state=self._final_state,
+            final_reset_count=np.zeros((B,), np.int32),
+        )
+
+    # -- the update ----------------------------------------------------
+
+    def ready(self) -> bool:
+        return len(self.buffer) >= self.B
+
+    def warmup(self) -> float:
+        """Compile the update program on a zero rollout (discarded
+        state) so the first REAL step — typically inside a pinned
+        zero-recompile serving window — reuses the cache."""
+        t0 = time.perf_counter()
+        dummy = [
+            Trajectory(0, [], 0.0, True) for _ in range(self.B)
+        ]
+        _st, _stats = self.trainer._update_jit(
+            self.state, self._pad_rollout(dummy)
+        )
+        jax.block_until_ready(_st.params)
+        return time.perf_counter() - t0
+
+    def step(self) -> dict[str, Any] | None:
+        """One learner update, if >= B completed trajectories are
+        buffered (None otherwise): drain (stale segments discarded by
+        the off-policy guard), pad, `ppo_update`, health-gate, and —
+        accepted — publish the new version to the bus. Returns the
+        step's info dict."""
+        trajs = self.buffer.drain(
+            self.B, current_version=self.version,
+            max_lag=self.max_param_lag,
+        )
+        while len(trajs) < self.B and len(self.buffer) > 0:
+            trajs += self.buffer.drain(
+                self.B - len(trajs), current_version=self.version,
+                max_lag=self.max_param_lag,
+            )
+        if len(trajs) < self.B:
+            # not enough fresh segments: requeue what we took (at the
+            # tail — order within one update batch is irrelevant)
+            self.buffer.requeue(trajs)
+            return None
+        ro = self._pad_rollout(trajs)
+        state2, stats = self.trainer._update_jit(self.state, ro)
+        jax.block_until_ready(state2.params)
+        stats = {
+            k: (None if v is None else float(v))
+            for k, v in stats.items()
+        }
+        mask = int(stats.get("health_mask") or 0)
+        info = {
+            "policy_loss": stats["policy_loss"],
+            "approx_kl_div": stats["approx_kl_div"],
+            "entropy": stats["entropy"],
+            "health_mask": mask,
+            "decisions": int(sum(tr.length for tr in trajs)),
+            "traj_reward_mean": float(
+                np.mean([tr.reward_sum for tr in trajs])
+            ),
+            "max_lag": max(
+                tr.max_lag(self.version) for tr in trajs
+            ),
+        }
+        if mask & RETRYABLE_MASK or not np.isfinite(
+            info["policy_loss"]
+        ):
+            # PR-9 rollback: keep the last-good TrainState, never
+            # publish a poisoned version
+            self.stats["learner_rejected"] += 1
+            if self.metrics is not None:
+                self.metrics.counter("online_learner_rejected")
+            if self.runlog is not None:
+                self.runlog.health(
+                    mask, action="learner_rollback",
+                    origin="online_learner",
+                )
+            emit(
+                f"[online] learner update rejected "
+                f"({describe_mask(mask) or ['non-finite loss']}); "
+                "state rolled back"
+            )
+            info["accepted"] = False
+            self.history.append(info)
+            return info
+        self.state = state2
+        self.version += 1
+        self.stats["learner_steps"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("online_learner_steps")
+        info["accepted"] = True
+        info["version"] = self.version
+        if self.bus is not None and (
+            self.version % self.swap_every == 0
+        ):
+            self.bus.publish(self.state.params, self.version)
+            self.stats["learner_published"] += 1
+        if self.runlog is not None:
+            self.runlog.scalars(self.version, {
+                "online_policy_loss": info["policy_loss"],
+                "online_kl": info["approx_kl_div"],
+                "online_traj_reward_mean": info["traj_reward_mean"],
+                "online_version": self.version,
+            })
+        self.history.append(info)
+        return info
+
+    # -- background mode -----------------------------------------------
+
+    def start_background(self, interval_s: float = 0.02) -> None:
+        """The IMPALA shape: a learner thread polling the buffer.
+        Updates run concurrently with serving dispatches (distinct XLA
+        programs); published params are APPLIED by the serving thread
+        via `ParamBus.pump`, between compiled calls, so the store's
+        single-owner donation discipline is never violated."""
+        if self._thread is not None:
+            raise RuntimeError("learner thread already running")
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                if self.ready():
+                    self.step()
+                else:
+                    time.sleep(interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="online-learner", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+        self._thread = None
+
+
+def _fill_lane(dst: np.ndarray, b: int, t: int, src) -> np.ndarray:
+    if t:
+        dst[b, :t] = np.asarray(src)[:t]
+    return dst

@@ -662,19 +662,35 @@ class DecimaScheduler(TrainableScheduler):
         return policy_fn
 
     def serve_policies(self, params=None, deterministic: bool = True):
-        """The `(policy_fn, batch_policy_fn)` pair the AOT decision
-        service compiles (`sparksched_tpu/serve/`): the unbatched
-        single-session program closes over `policy_fn`, the width-K
-        micro-batch program over `batch_policy_fn` — the SAME bound
-        parameters, so the two serve paths cannot disagree on weights.
-        Serving defaults to greedy (`deterministic=True`): a production
-        decision is the argmax of both heads, rng-independent, so equal
-        session states always serve equal decisions regardless of the
-        request's batch placement."""
+        """The `(policy_fn, batch_policy_fn)` pair with the parameters
+        BOUND as closure constants — the pre-ISSUE-14 serving binding,
+        kept for ad-hoc jit use. The AOT decision service compiles
+        `serve_param_policies` instead (explicit-params signature), so
+        weights stay a runtime argument and hot swap needs no
+        recompile. Serving defaults to greedy (`deterministic=True`):
+        a production decision is the argmax of both heads,
+        rng-independent, so equal session states always serve equal
+        decisions regardless of the request's batch placement."""
         p = self.params if params is None else params
         return (
             self.flat_policy(p, deterministic),
             self.flat_batch_policy(p, deterministic),
+        )
+
+    def serve_param_policies(self, deterministic: bool = True):
+        """The `(policy_fn, batch_policy_fn)` pair the AOT decision
+        service compiles since ISSUE 14, with the model parameters as
+        the LEADING EXPLICIT ARGUMENT:
+        `policy_fn(model_params, rng, obs)` /
+        `batch_policy_fn(model_params, rng, obs)`. Both serve paths
+        receive the same params value per call from the session store,
+        so they cannot disagree on weights — and because params enter
+        the compiled programs as ordinary arguments (not closure
+        constants), a new parameter version swaps in with zero
+        recompiles (the `ParamBus` hot-swap contract)."""
+        return (
+            lambda p, k, o: self.policy(k, o, p, deterministic),
+            lambda p, k, o: self.batch_policy(k, o, p, deterministic),
         )
 
     # -- host-side single decision ----------------------------------------

@@ -39,6 +39,23 @@ The per-decision health sentinel (`env/health.py:state_health` over
 the post-drain state + the span reward, ISSUE 9) rides every output:
 the session layer quarantines a session whose mask is non-zero instead
 of serving it again.
+
+Since ISSUE 14 (the online learning loop) the model parameters are an
+ORDINARY RUNTIME ARGUMENT of both compiled programs rather than
+closure constants baked into the executable: `policy_fn` takes
+`(model_params, rng, obs)` (`DecimaScheduler.serve_param_policies`),
+and the compiled signature is `(store, model_params, ...)`. Swapping
+to a new parameter version is therefore just passing a different
+argument value of identical avals — zero retracing, zero recompiles
+(pinned via the runlog jit hooks, tests/test_online.py), which is what
+makes hot param swap into live serving possible at all. The optional
+`record` flag (static, compile-time) makes `ServeOut` additionally
+carry the decision's `StoredObs` record — the same per-decision
+observation schema the training collectors scatter
+(`trainers/rollout.py:store_obs`) — so served decisions can feed the
+online `TrajectoryBuffer` without a second observe pass; with
+`record=False` the traced program is byte-identical to the pre-record
+pin (CI: the analysis registry re-measures the record-off programs).
 """
 
 from __future__ import annotations
@@ -87,6 +104,13 @@ class ServeOut(struct.PyTreeNode):
     wall_time: jnp.ndarray  # f32; lane wall clock after the drain
     health_mask: jnp.ndarray  # i32; sentinel bitmask (0 = healthy)
     valid: jnp.ndarray  # bool; real (non-padding) slot
+    # record=True programs only (ISSUE 14): the decision's StoredObs
+    # record (trainers/rollout.py schema). Meaningful only where
+    # `decided & valid` — padding lanes carry the clamped lane's
+    # speculative view, which the host-side consumer masks out. None
+    # (an empty pytree) on record-off programs, so their traced jaxpr
+    # is unchanged.
+    obs: Any = None
 
 
 # engine knobs of the serve drain — the round-5 on-chip calibration
@@ -105,20 +129,24 @@ def _decide_one(
     params: EnvParams,
     bank: WorkloadBank,
     policy_fn: Callable,
+    model_params: Any,
     ls: LoopState,
     key: jax.Array,
     force_stage: jnp.ndarray,
     force_nexec: jnp.ndarray,
     use_force: jnp.ndarray,
     knobs: dict[str, Any],
+    record: bool = False,
 ) -> tuple[LoopState, ServeOut]:
     """One lane's full decision: observe -> policy (or the forced
-    action under `use_force`) -> apply_and_drain -> health sentinel."""
+    action under `use_force`) -> apply_and_drain -> health sentinel.
+    `model_params` is the policy's parameter pytree, a runtime
+    argument (the hot-swap contract — see the module docstring)."""
     k_pol, k_env = jax.random.split(key)
     env0 = ls.env
     was_done = _lane_done(env0)
     obs = observe(params, env0)
-    stage_idx, num_exec, aux = policy_fn(k_pol, obs)
+    stage_idx, num_exec, aux = policy_fn(model_params, k_pol, obs)
     lgprob, job, _ = aux_action_fields(
         aux, stage_idx, num_exec, params.max_stages
     )
@@ -139,6 +167,11 @@ def _decide_one(
     )
     # a lane that was already done is frozen by the engine: report it
     # rather than claim a decision happened
+    rec_obs = None
+    if record:
+        from ..trainers.rollout import store_obs
+
+        rec_obs = store_obs(obs, env0)
     out = ServeOut(
         stage_idx=jnp.where(decided, stage_idx, -1).astype(_i32),
         job_idx=job,
@@ -151,6 +184,7 @@ def _decide_one(
         wall_time=ls2.env.wall_time,
         health_mask=jnp.where(was_done, 0, hm).astype(_i32),
         valid=jnp.bool_(True),
+        obs=rec_obs,
     )
     return ls2, out
 
@@ -161,28 +195,32 @@ def serve_decide_fn(
     policy_fn: Callable,
     knobs: dict[str, Any] | None = None,
     shard=None,
+    record: bool = False,
 ) -> Callable:
     """The single-session store program:
-    `(store [C], slot, key, force_stage, force_nexec, use_force) ->
-    (store [C], ServeOut)`. Gather one lane, decide unbatched, scatter
-    back; the store argument is meant to be donated at compile time.
+    `(store [C], model_params, slot, key, force_stage, force_nexec,
+    use_force) -> (store [C], ServeOut)`. Gather one lane, decide
+    unbatched, scatter back; the store argument is meant to be donated
+    at compile time, while `model_params` (the policy weights) is a
+    plain argument — new versions swap in with zero recompiles.
     With `shard` (a `NamedSharding` over the store's leading [C] axis,
     ISSUE 13), the store is sharding-constrained at entry and exit so
     the SPMD partitioner keeps the [C] session stack distributed over
     the `dp` mesh instead of gathering it to one device around the
     slot update — sessions are embarrassingly parallel, so the only
-    cross-device traffic is the served slot itself."""
+    cross-device traffic is the served slot itself. `record` (static,
+    ISSUE 14) adds the decision's `StoredObs` to the output."""
     kn = SERVE_KNOBS | (knobs or {})
 
-    def fn(store: LoopState, slot, key, force_stage, force_nexec,
-           use_force):
+    def fn(store: LoopState, model_params, slot, key, force_stage,
+           force_nexec, use_force):
         with annotate("serve/decide"):
             if shard is not None:
                 store = jax.lax.with_sharding_constraint(store, shard)
             ls = take_slot(store, slot)
             ls2, out = _decide_one(
-                params, bank, policy_fn, ls, key,
-                force_stage, force_nexec, use_force, kn,
+                params, bank, policy_fn, model_params, ls, key,
+                force_stage, force_nexec, use_force, kn, record=record,
             )
             store2 = jax.tree_util.tree_map(
                 lambda s, v: s.at[slot].set(v), store, ls2
@@ -201,20 +239,25 @@ def serve_decide_batch_fn(
     batch: int,
     knobs: dict[str, Any] | None = None,
     shard=None,
+    record: bool = False,
 ) -> Callable:
     """The micro-batched store program:
-    `(store [C], slots [K], key) -> (store [C], ServeOut-of-[K])`.
+    `(store [C], model_params, slots [K], key) ->
+    (store [C], ServeOut-of-[K])`.
     ONE batched policy evaluation over the K gathered sessions (the
     width-K `batch_policy` compaction is exactly a serving-batch
     primitive), vmapped apply-and-drain, scatter back. Padding slots
     carry index C: gathers clamp to a real lane whose results are then
     dropped by the `mode="drop"` scatter and masked in the output.
-    `shard` (ISSUE 13) constrains the [C] store axis to the `dp` mesh
-    at entry and exit, exactly as in `serve_decide_fn`."""
+    `model_params` is a runtime argument (one value per compiled call,
+    so every decision of a batch reads the SAME parameter version — no
+    torn reads across a batch, test-pinned). `shard` (ISSUE 13)
+    constrains the [C] store axis to the `dp` mesh at entry and exit;
+    `record` (static, ISSUE 14) adds per-lane `StoredObs` records."""
     kn = SERVE_KNOBS | (knobs or {})
     K = int(batch)
 
-    def fn(store: LoopState, slots, key):
+    def fn(store: LoopState, model_params, slots, key):
         with annotate("serve/decide_batch"):
             if shard is not None:
                 store = jax.lax.with_sharding_constraint(store, shard)
@@ -226,7 +269,9 @@ def serve_decide_batch_fn(
             was_done = jax.vmap(_lane_done)(env0)
             k_pol, k_env = jax.random.split(key)
             obs = jax.vmap(lambda e: observe(params, e))(env0)
-            stage_idx, num_exec, aux = batch_policy_fn(k_pol, obs)
+            stage_idx, num_exec, aux = batch_policy_fn(
+                model_params, k_pol, obs
+            )
             lgprob, job, _ = aux_action_fields(
                 aux, stage_idx, num_exec, params.max_stages
             )
@@ -241,6 +286,11 @@ def serve_decide_batch_fn(
             hm = jax.vmap(state_health)(
                 ls2.env, env0, reset
             ) | reward_health(reward)
+            rec_obs = None
+            if record:
+                from ..trainers.rollout import store_obs
+
+                rec_obs = jax.vmap(store_obs)(obs, env0)
             out = ServeOut(
                 stage_idx=jnp.where(
                     decided & valid, stage_idx, -1
@@ -257,6 +307,7 @@ def serve_decide_batch_fn(
                     was_done | ~valid, 0, hm
                 ).astype(_i32),
                 valid=valid,
+                obs=rec_obs,
             )
             # padding slots (index C) drop instead of scattering the
             # clamped lane's speculative update back over a real session
@@ -336,7 +387,7 @@ def serve_callables(
         num_executors=params.num_executors, job_bucket=8,
         **_shipped_agent_kwargs(),
     )
-    pol, bpol = sched.serve_policies(deterministic=True)
+    pol, bpol = sched.serve_param_policies(deterministic=True)
     key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
     ls1 = jax.eval_shape(init_loop_state, state)
     store = jax.tree_util.tree_map(
@@ -344,6 +395,15 @@ def serve_callables(
             (capacity,) + tuple(l.shape), l.dtype
         ),
         ls1,
+    )
+    # the model parameters as an abstract argument (ISSUE 14: weights
+    # are a runtime argument of the compiled serve programs, which is
+    # the whole hot-swap mechanism)
+    mp = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(
+            jnp.shape(a), jnp.result_type(a)
+        ),
+        sched.params,
     )
     i32 = jax.ShapeDtypeStruct((), jnp.int32)
     b = jax.ShapeDtypeStruct((), jnp.bool_)
@@ -367,16 +427,31 @@ def serve_callables(
     return {
         "serve_decide": (
             serve_decide_fn(params, bank, pol),
-            (store, i32, key, i32, i32, b),
+            (store, mp, i32, key, i32, i32, b),
         ),
         "serve_decide_batch": (
             serve_decide_batch_fn(params, bank, bpol, batch),
-            (store, slots, key),
+            (store, mp, slots, key),
         ),
         "serve_decide_batch_sharded": (
             serve_decide_batch_fn(
                 params, bank, bpol, batch, shard=shard
             ),
-            (store, slots, key),
+            (store, mp, slots, key),
+        ),
+        # ISSUE 14: the record-on variants the online trajectory path
+        # compiles (`SessionStore(record=True)`). Budgeted separately
+        # so (a) the recording cost is visible and capped, and (b) the
+        # record-off programs above prove the off path is structurally
+        # unchanged (byte-identical re-pin).
+        "serve_decide_record": (
+            serve_decide_fn(params, bank, pol, record=True),
+            (store, mp, i32, key, i32, i32, b),
+        ),
+        "serve_decide_batch_record": (
+            serve_decide_batch_fn(
+                params, bank, bpol, batch, record=True
+            ),
+            (store, mp, slots, key),
         ),
     }

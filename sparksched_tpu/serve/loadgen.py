@@ -145,6 +145,7 @@ def run_open_loop(
     session_seed: int = 10_000,
     keep_samples: bool = True,
     poll_sleep_s: float = 2e-4,
+    on_poll=None,
 ) -> dict[str, Any]:
     """Drive the schedule against the wall clock and return the run
     summary. One session per tenant is created up front (rotated on
@@ -172,7 +173,13 @@ def run_open_loop(
     session never closes its replacement), and —
     when `keep_samples` — the raw per-request `samples_ms` for exact
     percentiles (turn it off for million-request runs; the histogram
-    alone is O(buckets))."""
+    alone is O(buckets)).
+
+    `on_poll` (ISSUE 14): an optional zero-arg callable invoked once
+    per driver iteration, BETWEEN compiled serve calls — the hook the
+    online loop hangs `ParamBus.pump` on, so hot param swaps land
+    mid-run under live traffic without the driver knowing about
+    them."""
     n = len(arrivals)
     if n == 0:
         raise ValueError("empty arrival schedule")
@@ -217,6 +224,8 @@ def run_open_loop(
                 inflight.append(
                     (tenant, gen[tenant], sched_t, batcher.submit(sid))
                 )
+            if on_poll is not None:
+                on_poll()
             batcher.poll()
             if i >= n and batcher.pending:
                 # the schedule is exhausted: no co-riders are coming,

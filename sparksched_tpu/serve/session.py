@@ -124,16 +124,26 @@ class SessionQuarantined(RuntimeError):
 
 
 class ServeResult:
-    """Host-side view of one served decision (plain numpy scalars)."""
+    """Host-side view of one served decision (plain numpy scalars).
+
+    `params_version` is the STALENESS STAMP (ISSUE 14): the session
+    store's parameter version live at dispatch time. Every decision of
+    one batched compiled call shares one version (the params are a
+    single argument of the call — no torn reads across a batch), and
+    the online `TrajectoryBuffer` carries the stamp per decision so
+    the learner's off-policy guard can skip stale trajectories. `obs`
+    (record-on stores only, else None) is the decision's `StoredObs`
+    record as a host numpy pytree — the trajectory path's payload."""
 
     __slots__ = (
         "session_id", "stage_idx", "job_idx", "num_exec", "lgprob",
         "decided", "done", "reward", "dt", "wall_time", "health_mask",
-        "batched",
+        "batched", "params_version", "obs",
     )
 
     def __init__(self, session_id: int, out, i: int | None,
-                 batched: bool) -> None:
+                 batched: bool, params_version: int = 0,
+                 obs=None) -> None:
         pick = (lambda a: a[i]) if i is not None else (lambda a: a)
         self.session_id = session_id
         self.stage_idx = int(pick(out.stage_idx))
@@ -147,9 +157,17 @@ class ServeResult:
         self.wall_time = float(pick(out.wall_time))
         self.health_mask = int(pick(out.health_mask))
         self.batched = batched
+        self.params_version = int(params_version)
+        # obs extraction is the CALLER's job (one pytree flatten per
+        # compiled call, not one per result — the record path's host
+        # cost is on the serving hot path and A/B-measured against a
+        # 5% bar)
+        self.obs = obs
 
     def to_dict(self) -> dict[str, Any]:
-        return {k: getattr(self, k) for k in self.__slots__}
+        return {
+            k: getattr(self, k) for k in self.__slots__ if k != "obs"
+        }
 
 
 class SessionStore:
@@ -179,6 +197,8 @@ class SessionStore:
         tb_writer=None,
         metrics=None,
         trace: bool = False,
+        record: bool = False,
+        collector=None,
     ) -> None:
         hot = int(capacity if hot_capacity is None else hot_capacity)
         if not 1 <= hot <= capacity:
@@ -218,16 +238,40 @@ class SessionStore:
         self.last_spans: dict[str, float] | None = None
         self._base_key = jax.random.PRNGKey(seed)
         self._calls = 0
+        # ISSUE 14: trajectory recording (static compile choice) + the
+        # optional host-side collector fed one ServeResult per served
+        # decision (online.TrajectoryBuffer implements the protocol:
+        # .add(result) / .on_close(sid, quarantined=...))
+        self.record = bool(record)
+        self.collector = collector
 
-        pol, bpol = scheduler.serve_policies(
+        pol, bpol = scheduler.serve_param_policies(
             deterministic=deterministic
         )
         shard = None
-        if mesh is not None:
-            from ..parallel import lane_sharding
+        if mesh is None:
+            self._put_params = jax.device_put
+        else:
+            from ..parallel import lane_sharding, replicated
 
             shard = lane_sharding(mesh)
+            rep = replicated(mesh)
+            # params replicate over the mesh (the store's [C] axis is
+            # what shards); explicit placement keeps the AOT lowering's
+            # argument layout stable across swaps
+            self._put_params = lambda p: jax.device_put(p, rep)
         self._shard = shard
+
+        # ISSUE 14: the model parameters are a runtime ARGUMENT of the
+        # compiled serve programs (not closure constants), so a new
+        # version swaps in between compiled calls with zero recompiles.
+        # `params_version` is the staleness stamp every ServeResult
+        # carries; `_last_good_params` backs the quarantine-style
+        # rollback (`rollback_params` / online.ParamBus).
+        self._model_params = self._put_params(scheduler.params)
+        self.params_version = 0
+        self._last_good_params = self._model_params
+        self._last_good_version = 0
         self._reset1 = jax.jit(
             lambda k: init_loop_state(core.reset(params, bank, k))
         )
@@ -252,20 +296,25 @@ class SessionStore:
 
         # ---- AOT lowering + compile (the cold start) ----
         fn1 = serve_decide_fn(params, bank, pol, self.knobs,
-                              shard=shard)
+                              shard=shard, record=self.record)
         fnk = serve_decide_batch_fn(
-            params, bank, bpol, self.max_batch, self.knobs, shard=shard
+            params, bank, bpol, self.max_batch, self.knobs,
+            shard=shard, record=self.record,
         )
         st_abs = abstract_like(store, keep_sharding=shard is not None)
+        mp_abs = abstract_like(
+            self._model_params, keep_sharding=mesh is not None
+        )
         key = abstract_like(self._base_key)
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         b = jax.ShapeDtypeStruct((), jnp.bool_)
         slots = jax.ShapeDtypeStruct((self.max_batch,), jnp.int32)
         self._c1, secs1 = aot_compile(
-            fn1, st_abs, i32, key, i32, i32, b, donate_store=donate
+            fn1, st_abs, mp_abs, i32, key, i32, i32, b,
+            donate_store=donate,
         )
         self._ck, secsk = aot_compile(
-            fnk, st_abs, slots, key, donate_store=donate
+            fnk, st_abs, mp_abs, slots, key, donate_store=donate
         )
         self.compile_secs = {"decide": secs1, "decide_batch": secsk}
 
@@ -301,6 +350,9 @@ class SessionStore:
             "serve_capacity_rejections": 0,
             "serve_page_ins": 0,
             "serve_page_outs": 0,
+            "serve_param_swaps": 0,
+            "serve_param_rollbacks": 0,
+            "serve_param_version": 0,
         }
 
         # ---- warmup: one call per program, so the warm path never
@@ -327,12 +379,14 @@ class SessionStore:
 
     def _call1(self, slot, fstage, fnexec, use_force):
         return self._c1(
-            self._store, slot, self._next_key(), fstage, fnexec,
-            use_force,
+            self._store, self._model_params, slot, self._next_key(),
+            fstage, fnexec, use_force,
         )
 
     def _callk(self, slots):
-        return self._ck(self._store, slots, self._next_key())
+        return self._ck(
+            self._store, self._model_params, slots, self._next_key()
+        )
 
     def _served(self, call):
         """Run one compiled serve call and hand back host-side outputs.
@@ -341,17 +395,26 @@ class SessionStore:
         issued), `device_compute` (its outputs are ready),
         `scatter_back` (the host holds concrete values). The off path
         is byte-identical to the uninstrumented round-13 behavior."""
+        # host materialization is per-LEAF np.asarray (each conversion
+        # syncs on its buffer) rather than jax.device_get: measured
+        # ~3x cheaper on the serve outputs, which matters once the
+        # record-on programs (ISSUE 14) nearly double the output leaf
+        # count — the record-overhead A/B bar is 5% of a
+        # millisecond-scale call
+        to_host = lambda o: jax.tree_util.tree_map(  # noqa: E731
+            np.asarray, o
+        )
         if not self.trace:
             # stale spans from a previously-traced window must never
             # merge into a later request's trace
             self.last_spans = None
             self._store, out = call()
-            return jax.device_get(out)
+            return to_host(out)
         t_dispatch = time.perf_counter()
         self._store, out = call()
         jax.block_until_ready(out)
         t_compute = time.perf_counter()
-        out = jax.device_get(out)
+        out = to_host(out)
         t_scatter = time.perf_counter()
         self.last_spans = {
             "dispatch": t_dispatch,
@@ -463,6 +526,110 @@ class SessionStore:
             dp=1 if self.mesh is None else int(self.mesh.size),
         )
 
+    @property
+    def model_params(self):
+        """The live serving parameter pytree (the device copy the
+        compiled programs receive) — what an online learner seeds its
+        train state from."""
+        return self._model_params
+
+    def is_hot(self, sid: int) -> bool:
+        """Whether the session currently holds a device slot (False =
+        paged out to host RAM; serving it next pays a page-in). The
+        pager-aware admission preference (`ContinuousBatcher`) reads
+        this when forming batches."""
+        return (
+            0 <= sid < self.capacity and int(self._slot_of[sid]) >= 0
+        )
+
+    # -- hot param swap (ISSUE 14) -----------------------------------------
+
+    def set_params(self, model_params, version: int | None = None,
+                   origin: str = "swap", reason: str | None = None,
+                   mark_good: bool = True) -> int:
+        """Swap the serving parameters to a new version — between
+        compiled calls, zero recompiles (the params are a runtime
+        argument of both AOT programs; aval-identical values never
+        retrace, pinned by tests/test_online.py via the runlog jit
+        hooks). Every later decision carries the new version as its
+        staleness stamp; decisions of an already-dispatched batch keep
+        the version live at THEIR dispatch (one params value per
+        compiled call — no torn reads). Writes a versioned runlog
+        `params_swap` record. With `mark_good` (default), the
+        OUTGOING version becomes the rollback target — pass False when
+        re-publishing over a version still on probation
+        (online.ParamBus does)."""
+        new_l, new_def = jax.tree_util.tree_flatten(model_params)
+        cur_l, cur_def = jax.tree_util.tree_flatten(self._model_params)
+        mismatch = None
+        if new_def != cur_def:
+            mismatch = "pytree structure"
+        else:
+            for a, b in zip(new_l, cur_l):
+                if (jnp.shape(a) != jnp.shape(b)
+                        or jnp.result_type(a) != jnp.result_type(b)):
+                    mismatch = (
+                        f"leaf aval {jnp.shape(a)}/{jnp.result_type(a)}"
+                        f" vs {jnp.shape(b)}/{jnp.result_type(b)}"
+                    )
+                    break
+        if mismatch is not None:
+            # reject HERE, where the caller can keep serving the old
+            # version — a drifted-architecture publish that slipped
+            # through would instead crash the next compiled call
+            # mid-traffic
+            raise ValueError(
+                "set_params: new parameters do not match the compiled "
+                f"programs' ({mismatch}) — a swap may only change "
+                "values, never shapes/structure (that would need a "
+                "recompile)"
+            )
+        prev_version = self.params_version
+        if mark_good:
+            self._last_good_params = self._model_params
+            self._last_good_version = prev_version
+        self._model_params = self._put_params(model_params)
+        self.params_version = (
+            prev_version + 1 if version is None else int(version)
+        )
+        self.stats["serve_param_swaps"] += 1
+        self.stats["serve_param_version"] = self.params_version
+        if self.metrics is not None:
+            self.metrics.counter("serve_param_swaps")
+            self.metrics.gauge(
+                "serve_param_version", self.params_version
+            )
+        if self._runlog is not None:
+            self._runlog.params_swap(
+                self.params_version, prev_version=prev_version,
+                action=origin, reason=reason,
+            )
+        return self.params_version
+
+    def rollback_params(self, reason: str | None = None) -> int:
+        """Quarantine-style rollback to the last-good parameter
+        version (the one live before the most recent `set_params` with
+        `mark_good`) — the swap-side analog of the trainer's
+        rollback-and-retry. Same zero-recompile path as `set_params`;
+        records a `params_swap` runlog record with
+        `action="rollback"`."""
+        prev_version = self.params_version
+        self._model_params = self._last_good_params
+        self.params_version = self._last_good_version
+        self.stats["serve_param_rollbacks"] += 1
+        self.stats["serve_param_version"] = self.params_version
+        if self.metrics is not None:
+            self.metrics.counter("serve_param_rollbacks")
+            self.metrics.gauge(
+                "serve_param_version", self.params_version
+            )
+        if self._runlog is not None:
+            self._runlog.params_swap(
+                self.params_version, prev_version=prev_version,
+                action="rollback", reason=reason,
+            )
+        return self.params_version
+
     # -- session lifecycle -------------------------------------------------
 
     def create(self, seed: int | None = None) -> int:
@@ -504,6 +671,12 @@ class SessionStore:
 
     def close(self, sid: int) -> None:
         self._check_sid(sid, allow_quarantined=True)
+        if self.collector is not None:
+            # finalize (or drop, when quarantined) the session's open
+            # trajectory before the sid is reused by a fresh episode
+            self.collector.on_close(
+                sid, quarantined=bool(self._quarantined[sid])
+            )
         slot = int(self._slot_of[sid])
         if slot >= 0:
             self._sid_of[slot] = -1
@@ -544,15 +717,26 @@ class SessionStore:
 
     # -- serving -----------------------------------------------------------
 
+    def _record_result(self, res: ServeResult) -> None:
+        """Feed one served decision to the trajectory collector (the
+        online actor path, ISSUE 14). The collector owns episode
+        assembly and eviction; a quarantining decision still reaches
+        it (the collector drops the poisoned episode itself)."""
+        if self.collector is not None:
+            self.collector.add(res)
+
     def decide(self, sid: int) -> ServeResult:
         """One policy decision on the unbatched AOT path."""
         self._check_sid(sid)
         [slot] = self._ensure_hot([sid])
+        ver = self.params_version  # staleness stamp: live at dispatch
         out = self._served(lambda: self._call1(
             _i32(slot), _i32(-1), _i32(0), jnp.bool_(False)
         ))
-        res = ServeResult(sid, out, None, batched=False)
+        res = ServeResult(sid, out, None, batched=False,
+                          params_version=ver, obs=out.obs)
         self._apply_health(sid, res.health_mask)
+        self._record_result(res)
         self.stats["serve_decisions"] += 1
         return res
 
@@ -562,19 +746,24 @@ class SessionStore:
         policy's pick is overridden by the forced-action select)."""
         self._check_sid(sid)
         [slot] = self._ensure_hot([sid])
+        ver = self.params_version
         out = self._served(lambda: self._call1(
             _i32(slot), _i32(stage_idx), _i32(num_exec),
             jnp.bool_(True),
         ))
-        res = ServeResult(sid, out, None, batched=False)
+        res = ServeResult(sid, out, None, batched=False,
+                          params_version=ver, obs=out.obs)
         self._apply_health(sid, res.health_mask)
+        self._record_result(res)
         self.stats["serve_decisions"] += 1
         return res
 
     def decide_batch(self, sids: list[int]) -> list[ServeResult]:
         """Up to `max_batch` sessions in ONE compiled call. A single
         session falls back to the unbatched path (no padded batch work
-        for a lone request)."""
+        for a lone request). All results of one call share one
+        `params_version` — the params are a single argument of the
+        compiled program, so a swap can never tear mid-batch."""
         if not sids:
             return []
         if len(sids) > self.max_batch:
@@ -590,11 +779,24 @@ class SessionStore:
         batch_slots = self._ensure_hot(sids)
         slots = np.full(self.max_batch, self.hot_capacity, np.int32)
         slots[: len(sids)] = batch_slots
+        ver = self.params_version
         out = self._served(lambda: self._callk(jnp.asarray(slots)))
+        obs_leaves = obs_tdef = None
+        if out.obs is not None:
+            # ONE flatten per call; per-result obs are unflattened
+            # numpy views (treedef.unflatten is C++), not K tree_maps
+            obs_leaves, obs_tdef = jax.tree_util.tree_flatten(out.obs)
         results = []
         for i, sid in enumerate(sids):
-            res = ServeResult(sid, out, i, batched=True)
+            obs_i = None
+            if obs_leaves is not None:
+                obs_i = obs_tdef.unflatten(
+                    [leaf[i] for leaf in obs_leaves]
+                )
+            res = ServeResult(sid, out, i, batched=True,
+                              params_version=ver, obs=obs_i)
             self._apply_health(sid, res.health_mask)
+            self._record_result(res)
             results.append(res)
         self.stats["serve_decisions"] += len(sids)
         self.stats["serve_batched_decisions"] += len(sids)
@@ -677,6 +879,13 @@ def _finish_ticket(t: Ticket, store: SessionStore, metrics, runlog
         runlog.trace(
             t.trace.trace_id, t.trace.offsets_ms(),
             session_id=t.session_id,
+            # staleness stamp (ISSUE 14): the parameter version the
+            # decision was served under rides the trace record, so a
+            # post-hoc reader can align tail-latency spans with swaps
+            params_version=(
+                None if t.result is None
+                else t.result.params_version
+            ),
             error=None if t.error is None
             else type(t.error).__name__,
         )
@@ -838,6 +1047,21 @@ class ContinuousBatcher:
     error) instead of riding later batches — while co-queued sessions
     are unaffected.
 
+    Pager-aware admission (ISSUE 14 satellite, ROADMAP item 2's named
+    leftover): with `pager_aware` (default True) and a PAGED store
+    (`hot_capacity < capacity`), round-robin ties break toward
+    already-HOT sessions — within a bounded look-ahead window of the
+    rotation (2K entries), resident sessions are admitted before
+    paged-out ones, so a batch prefers slots that need no page
+    round-trip. Fairness stays structural: a session skipped
+    `max_skips` times is admitted unconditionally on its next
+    eligibility, so the starvation bound only stretches from
+    ceil(S/K) to ceil(S/K) + max_skips pumps. On an unpaged store
+    (hot_capacity == capacity) the preference is inert and admission
+    is byte-identical to the round-15 rotation. Cold admissions land
+    in the `serve_page_churn` metrics counter (each one forces a page
+    round-trip when the hot set is full).
+
     Instrumentation mirrors `MicroBatcher` (shared `_finish_ticket`):
     flush reasons are `size` (a full slot dispatched at submit),
     `occupancy` (a pump dispatched a partial slot) and `forced`
@@ -847,13 +1071,17 @@ class ContinuousBatcher:
     front_name = "continuous"
 
     def __init__(self, store: SessionStore, *, metrics=None,
-                 runlog=None, trace: bool = False) -> None:
+                 runlog=None, trace: bool = False,
+                 pager_aware: bool = True, max_skips: int = 2) -> None:
         self.store = store
         self.metrics = metrics
         self.runlog = runlog
         self.trace = bool(trace)
+        self.pager_aware = bool(pager_aware)
+        self.max_skips = int(max_skips)
         self._queues: dict[int, deque[Ticket]] = {}
         self._rotation: deque[int] = deque()
+        self._skips: dict[int, int] = {}
 
     def submit(self, sid: int) -> Ticket:
         t = Ticket(sid, traced=self.trace)
@@ -905,6 +1133,7 @@ class ContinuousBatcher:
                 continue
             sid = t.session_id
             q = self._queues.pop(sid, None)
+            self._skips.pop(sid, None)
             if sid in self._rotation:
                 self._rotation.remove(sid)
             while q:
@@ -915,21 +1144,70 @@ class ContinuousBatcher:
                 )
                 self._finish(tk)
 
+    def _admit_sids(self) -> list[int]:
+        """Up to `max_batch` sessions off the rotation. Plain
+        round-robin order, EXCEPT when the store pages
+        (hot_capacity < capacity) and `pager_aware` is on: within a
+        bounded 2K look-ahead window, sessions skipped `max_skips`
+        times admit first (the fairness valve), then resident (hot)
+        sessions, then cold ones — all in rotation order within each
+        class. Sessions passed over are charged one skip and KEEP
+        their rotation position, so the preference can only delay a
+        head by `max_skips` pumps."""
+        K = min(self.store.max_batch, len(self._rotation))
+        st = self.store
+        if (not self.pager_aware or st.hot_capacity >= st.capacity
+                or len(self._rotation) <= K):
+            out = [self._rotation.popleft() for _ in range(K)]
+            for s in out:
+                # an admission by ANY path resets the starvation
+                # valve, or a just-served session could force-admit
+                # as "starved" on its next eligibility
+                self._skips.pop(s, None)
+            return out
+        window = list(self._rotation)[: 2 * st.max_batch]
+        forced = [
+            s for s in window
+            if self._skips.get(s, 0) >= self.max_skips
+        ]
+        taken = set(forced[:K])
+        picked = forced[:K]
+        for prefer_hot in (True, False):
+            for s in window:
+                if len(picked) >= K:
+                    break
+                if s in taken or st.is_hot(s) is not prefer_hot:
+                    continue
+                picked.append(s)
+                taken.add(s)
+        n_cold = sum(1 for s in picked if not st.is_hot(s))
+        if self.metrics is not None and n_cold:
+            # each cold admission is one page round-trip once the hot
+            # set is full — the churn the preference exists to cut
+            self.metrics.counter("serve_page_churn", n_cold)
+        for s in window:
+            if s not in taken:
+                self._skips[s] = self._skips.get(s, 0) + 1
+        for s in picked:
+            self._skips.pop(s, None)
+        self._rotation = deque(
+            s for s in self._rotation if s not in taken
+        )
+        return picked
+
     def pump(self, reason: str = "occupancy") -> bool:
         """Admit up to `max_batch` queue heads (round-robin over the
-        session rotation) and serve them in ONE compiled call; True
-        when a batch ran."""
+        session rotation, hot-preferring under a paged store) and
+        serve them in ONE compiled call; True when a batch ran."""
         if not self._rotation:
             return False
         m = self.metrics
         if m is not None:
             m.counter(f"serve_flush_{reason}")
             m.observe("serve_queue_depth", self.pending)
-        batch: list[Ticket] = []
-        for _ in range(min(self.store.max_batch,
-                           len(self._rotation))):
-            sid = self._rotation.popleft()
-            batch.append(self._queues[sid].popleft())
+        batch: list[Ticket] = [
+            self._queues[sid].popleft() for sid in self._admit_sids()
+        ]
         # backlogged sessions re-join the rotation TAIL in admission
         # order — the round-robin step of the fairness bound
         for t in batch:
@@ -1006,6 +1284,9 @@ def store_from_config(
         # MetricsRegistry (callers needing a shared registry pass one
         # via overrides)
         "trace": bool(cfg.get("trace", False)),
+        # ISSUE 14: compile the record-on serve programs (per-decision
+        # StoredObs records — the online trajectory path's payload)
+        "record": bool(cfg.get("record", False)),
     }
     # ISSUE 13: the pager (device slots < sessions) and the dp-sharded
     # store; both default off so an r11 block builds an r11 store
@@ -1035,6 +1316,9 @@ def front_from_config(
     cfg = dict(cfg or {})
     front = str(cfg.get("front", "continuous"))
     if front == "continuous":
+        overrides.setdefault(
+            "pager_aware", bool(cfg.get("pager_aware", True))
+        )
         return ContinuousBatcher(store, **overrides)
     if front == "linger":
         return MicroBatcher(

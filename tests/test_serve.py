@@ -83,11 +83,16 @@ def test_aot_step_exact_vs_jit_and_donation_aliasing(setup):
     leaves are deleted and the output reuses the input buffer (the
     zero-allocation steady state the donation exists for)."""
     params, bank, sched = setup
-    pol, _ = sched.serve_policies(deterministic=False)  # rng-sensitive
+    # rng-sensitive policy, explicit-params signature (ISSUE 14: the
+    # model params are a runtime argument of the compiled program)
+    pol, _ = sched.serve_param_policies(deterministic=False)
     fn = serve_decide_fn(params, bank, pol)
     st = _tiny_store_state(params, bank)
     key = jax.random.PRNGKey(3)
-    args = (_i32(1), key, _i32(-1), _i32(0), jnp.bool_(False))
+    args = (
+        sched.params, _i32(1), key, _i32(-1), _i32(0),
+        jnp.bool_(False),
+    )
 
     st_jit = jax.tree_util.tree_map(jnp.copy, st)
     out_jit = jax.jit(fn)(st_jit, *args)  # no donation: the reference

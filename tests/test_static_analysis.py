@@ -52,6 +52,11 @@ def test_shipped_tree_is_analysis_clean():
         # constraints are part of the traced program, so the audited
         # jaxpr IS the sharded configuration)
         "serve_decide_batch_sharded",
+        # ISSUE 14: the record-on serve variants (the online loop's
+        # actor path), budgeted separately so the recording cost is
+        # capped while the record-off programs above pin that record
+        # off changes nothing
+        "serve_decide_record", "serve_decide_batch_record",
     }
     assert set(report["passes"]["jaxpr"]["measured"]) == all_programs
     mem = report["passes"]["memory"]["measured"]
