@@ -494,8 +494,32 @@ def bench_serve_latency(
     }
     rows: list[dict] = []
 
-    def emit(metric: str, samples_ms: list[float], cfg_extra: dict
-             ) -> None:
+    def wall_split_block(ws0: dict, n_calls: int) -> dict:
+        """ISSUE 15 satellite: the timed window's wall time split into
+        `dispatch_wall` (issuing compiled calls — async, returns
+        futures) vs `blocked_host_wall` (inside
+        `block_until_ready`/`np.asarray` syncs), from the store's
+        cumulative counters delta'd across the window. The r10
+        percentile fields are untouched; this block sits NEXT TO them
+        so pipeline overlap (a shrinking blocked share) is visible in
+        the row schema."""
+        d_ms = (store.wall_split["dispatch_s"] - ws0["dispatch_s"]) * 1e3
+        b_ms = (
+            store.wall_split["blocked_host_s"] - ws0["blocked_host_s"]
+        ) * 1e3
+        return {
+            "dispatch_wall_ms": round(d_ms, 3),
+            "blocked_host_wall_ms": round(b_ms, 3),
+            "dispatch_wall_ms_per_call": round(d_ms / n_calls, 4),
+            "blocked_host_wall_ms_per_call": round(b_ms / n_calls, 4),
+            "blocked_fraction": round(
+                b_ms / max(d_ms + b_ms, 1e-9), 4
+            ),
+            "calls": n_calls,
+        }
+
+    def emit(metric: str, samples_ms: list[float], cfg_extra: dict,
+             wall_split: dict | None = None) -> None:
         from sparksched_tpu.obs.metrics import hist_summary
 
         lat = _latency_block(samples_ms, len(samples_ms)) | cold
@@ -503,6 +527,8 @@ def bench_serve_latency(
         # NEXT TO the exact percentiles (same samples; the exact
         # p50/p90/p99 fields above are unchanged from the r10 schema)
         lat["hist"] = hist_summary(samples_ms)
+        if wall_split is not None:
+            lat["wall_split"] = wall_split
         if cfg_extra.get("batch", 1) > 1:
             lat["per_decision_p50_ms"] = round(
                 lat["p50_ms"] / cfg_extra["batch"], 4
@@ -525,6 +551,7 @@ def bench_serve_latency(
     # batch set served below) ---
     one = store.create(seed=3000)
     samples = []
+    ws0 = dict(store.wall_split)
     for i in range(reps):
         t1 = time.perf_counter()
         r = store.decide(one)
@@ -536,10 +563,12 @@ def bench_serve_latency(
             store.close(one)
             one = store.create(seed=4000 + i)
     store.close(one)
-    emit("serve_decide_latency_batch1", samples, {"batch": 1})
+    emit("serve_decide_latency_batch1", samples, {"batch": 1},
+         wall_split=wall_split_block(ws0, reps))
 
     # --- batch=K: one compiled width-K call per timed rep ---
     samples = []
+    ws0 = dict(store.wall_split)
     for i in range(reps):
         t1 = time.perf_counter()
         results = store.decide_batch(sids)
@@ -551,6 +580,7 @@ def bench_serve_latency(
     emit(
         f"serve_decide_latency_batch{max_batch}", samples,
         {"batch": max_batch},
+        wall_split=wall_split_block(ws0, reps),
     )
 
     # --- bounded-linger sweep: one lone request through the batcher;
@@ -561,6 +591,7 @@ def bench_serve_latency(
         mb = MicroBatcher(store, linger_ms=linger_ms)
         lone = store.create(seed=5000)
         samples = []
+        ws0 = dict(store.wall_split)
         for i in range(max(10, reps // 5)):
             tk = mb.submit(lone)
             while not tk.ready:
@@ -579,6 +610,7 @@ def bench_serve_latency(
         emit(
             f"serve_batcher_latency_linger{linger_ms:g}ms", samples,
             {"batch": 1, "linger_ms": linger_ms, "front": "batcher"},
+            wall_split=wall_split_block(ws0, len(samples)),
         )
 
     os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
@@ -613,7 +645,24 @@ def _serve_obs_overhead(store, reps: int = 30) -> dict:
     from sparksched_tpu.obs.runlog import RunLog
     from sparksched_tpu.serve import MicroBatcher
 
-    sids = [store.create(seed=9000 + i) for i in range(store.max_batch)]
+    def same_group_sessions(base: int) -> list[int]:
+        # a full-batch flush is ONE compiled call and must live in one
+        # slot group (ISSUE 15): over-create, keep max_batch sessions
+        # of the first session's group, release the rest
+        cand = [
+            store.create(seed=base + i)
+            for i in range(2 * store.max_batch)
+        ]
+        g0 = store.session_group(cand[0])
+        keep = [
+            s for s in cand if store.session_group(s) == g0
+        ][: store.max_batch]
+        for s in cand:
+            if s not in keep:
+                store.close(s)
+        return keep
+
+    sids = same_group_sessions(9000)
     rl = RunLog(
         os.path.join(tempfile.mkdtemp(prefix="serve_ab_"), "ab.jsonl")
     )
@@ -623,10 +672,7 @@ def _serve_obs_overhead(store, reps: int = 30) -> dict:
         if any(r.done or r.health_mask for r in results):
             for s in sids:
                 store.close(s)
-            sids = [
-                store.create(seed=9500 + i)
-                for i in range(store.max_batch)
-            ]
+            sids = same_group_sessions(9500)
 
     def window(mb):
         t0 = time.perf_counter()
@@ -667,7 +713,7 @@ def _serve_obs_overhead(store, reps: int = 30) -> dict:
 
 
 def bench_serve_scale(
-    artifact: str = "artifacts/serve_scale_r16.json",
+    artifact: str = "artifacts/serve_scale_r17.json",
 ) -> list[dict]:
     """Serving at load (ISSUE 11/13): open-loop offered-load sweep
     over the AOT session store, reporting GOODPUT under a p99 SLO —
@@ -717,21 +763,35 @@ def bench_serve_scale(
     max_batch = int(os.environ.get("SERVE_SCALE_BATCH", 8))
     with_mmpp = os.environ.get("SERVE_SCALE_MMPP", "1") == "1"
     seed = int(os.environ.get("SERVE_SCALE_SEED", 11))
+    # ISSUE 15: the round-17 default A/B isolates PIPELINING — the
+    # synchronous continuous front (depth 1) vs the pipelined front
+    # (depth D over G slot groups) on the SAME grouped store, so the
+    # in-flight window is the only variable. `linger` remains runnable
+    # for the r13-protocol three-way.
     fronts = [
         f.strip() for f in os.environ.get(
-            "SERVE_SCALE_FRONTS", "linger,continuous"
+            "SERVE_SCALE_FRONTS", "continuous,pipelined"
         ).split(",") if f.strip()
     ]
-    unknown_fronts = set(fronts) - {"linger", "continuous"}
+    unknown_fronts = set(fronts) - {"linger", "continuous", "pipelined"}
     if unknown_fronts:
         # fail loudly (the serve-config contract): a typo'd front
         # would silently run the fallback arm twice and stamp the
         # paired A/B rows with a label that never ran
         raise ValueError(
             f"unknown SERVE_SCALE_FRONTS entr(y/ies) "
-            f"{sorted(unknown_fronts)}; known: continuous, linger"
+            f"{sorted(unknown_fronts)}; known: continuous, linger, "
+            "pipelined"
         )
     ab_reps = int(os.environ.get("SERVE_SCALE_AB_REPS", 3))
+    # CPU default: groups=1 (consecutive calls chain on the one donated
+    # buffer, which the depth-2 window never waits on) — slot groups
+    # buy true call concurrency only where device and host are
+    # different silicon, so the chip stage (17) runs groups=4 while
+    # the CPU A/B isolates the async dispatch/harvest split
+    groups = int(os.environ.get("SERVE_SCALE_GROUPS", 1))
+    depth = int(os.environ.get("SERVE_SCALE_DEPTH", max(2, groups)))
+    harvester = os.environ.get("SERVE_SCALE_HARVESTER", "0") == "1"
 
     from sparksched_tpu.obs.metrics import (
         MetricsRegistry,
@@ -751,11 +811,32 @@ def bench_serve_scale(
     params, bank, sched = _serve_setup()
     runlog = RunLog.create("artifacts", name=None)
     t0 = time.perf_counter()
+    # the sync arms' store (and the obs-overhead / hot-set / online-A/B
+    # subject): groups=1 — the linger and continuous rows ARE the
+    # r11/r13 fronts, byte-for-byte. At the CPU default
+    # (groups=1, no harvester) the pipelined arm SHARES this store, so
+    # the A/B isolates the front (r13 pairing discipline); with
+    # SERVE_SCALE_GROUPS>1 (the chip stage) it gets its own grouped
+    # store — the slot-group layout is then part of the architecture
+    # under test, compared at identical seeded schedules.
     store = SessionStore(
         params, bank, sched, capacity=capacity,
         hot_capacity=hot_capacity, max_batch=max_batch,
         deterministic=True, seed=0, runlog=runlog,
     )
+    store_pipe = None
+    if "pipelined" in fronts:
+        if groups == 1 and not harvester:
+            # same layout as the sync arms: share the store, so the
+            # A/B isolates the FRONT (r13 pairing discipline)
+            store_pipe = store
+        else:
+            store_pipe = SessionStore(
+                params, bank, sched, capacity=capacity,
+                hot_capacity=hot_capacity, groups=groups,
+                harvester=harvester, max_batch=max_batch,
+                deterministic=True, seed=0, runlog=runlog,
+            )
     cold_start_s = time.perf_counter() - t0
     hot_set = store.hot_set_advice()
 
@@ -790,20 +871,27 @@ def bench_serve_scale(
             rate, n_req, tenants, process=process, seed=seed
         )
         reg = MetricsRegistry()
-        store.metrics, store.trace = reg, True
-        if front == "continuous":
+        st = store_pipe if front == "pipelined" else store
+        st.metrics, st.trace = reg, True
+        if front == "pipelined":
             b = ContinuousBatcher(
-                store, metrics=reg, runlog=runlog, trace=True
+                st, depth=depth, metrics=reg, runlog=runlog,
+                trace=True,
+            )
+        elif front == "continuous":
+            b = ContinuousBatcher(
+                st, metrics=reg, runlog=runlog, trace=True
             )
         else:
             b = MicroBatcher(
-                store, linger_ms=linger_ms, metrics=reg,
+                st, linger_ms=linger_ms, metrics=reg,
                 runlog=runlog, trace=True,
             )
         summary = run_open_loop(
-            store, b, arrivals, slo_ms=slo_ms,
+            st, b, arrivals, slo_ms=slo_ms,
             session_seed=20_000 + int(rate),
         )
+        st.metrics, st.trace = None, False
         samples = summary.pop("samples_ms")
         hist = summary.pop("hist")
         return summary, samples, hist, reg.snapshot()
@@ -831,8 +919,11 @@ def bench_serve_scale(
             if process == "poisson":
                 p99_med[(front, rate)] = med_p99
             # linger rows keep the r11 metric names (directly
-            # comparable at equal offered load); continuous adds _cb
-            suffix = "_cb" if front == "continuous" else ""
+            # comparable at equal offered load); continuous adds _cb,
+            # pipelined _pipe
+            suffix = {
+                "continuous": "_cb", "pipelined": "_pipe",
+            }.get(front, "")
             row = {
                 "metric": (
                     f"serve_scale_offered{rate:g}rps{tag}{suffix}"
@@ -927,6 +1018,15 @@ def bench_serve_scale(
                 "config": base_cfg | {
                     "offered_rps": rate, "process": process,
                     "front": front,
+                    # the arm's serve architecture (ISSUE 15): sync
+                    # arms run the r13 single-group layout, the
+                    # pipelined arm its G-group depth-D window
+                    "groups": (
+                        groups if front == "pipelined" else 1
+                    ),
+                    "pipeline_depth": (
+                        depth if front == "pipelined" else 1
+                    ),
                     "cold_start_s": round(cold_start_s, 3),
                 },
                 "on_chip": _on_chip_block(),
@@ -1023,6 +1123,16 @@ def bench_serve_scale(
         store.metrics, store.trace = None, False
         on_state = (store_on.metrics, store_on.collector)
         store_on.metrics, store_on.collector = None, None
+        # pin BOTH arms to the SAME policy for the record A/B: the
+        # online window just hot-swapped learned params into store_on,
+        # and a different policy changes decision and drain costs —
+        # this A/B isolates the record PATH's serving cost, not the
+        # learner's behavioral effect (round-17 fix: with effective
+        # learning the confound dwarfed the record cost)
+        store_on.set_params(
+            jax.device_get(store.model_params), mark_good=False,
+            origin="record_ab_pin",
+        )
         ab_sched = generate_arrivals(
             on_rate, max(n_req // 2, 60), tenants, seed=seed + 4
         )
@@ -1157,6 +1267,21 @@ def bench_serve_scale(
                       "run granularity)",
                 "fronts": fronts,
                 "ab_reps": ab_reps,
+                # ISSUE 15: the pipelined arm's architecture (its own
+                # G-group store; the sync arms run the r13 layout, so
+                # the A/B compares the two serve ARCHITECTURES at
+                # identical seeded schedules)
+                "pipeline": None if store_pipe is None else {
+                    "groups": store_pipe.groups,
+                    "depth": depth,
+                    "harvester": harvester,
+                    "inflight_peak": store_pipe.stats[
+                        "serve_inflight_peak"
+                    ],
+                    "prefetches": store_pipe.stats[
+                        "serve_prefetches"
+                    ],
+                },
                 "sustained_rps_slo": sustained,
                 # run-invariant store sizing (the pager's capacity
                 # model): stamped ONCE here, not per row

@@ -738,6 +738,109 @@ def stage_serve_cb():
     print(f"[serve-cb] subprocess rc={r.returncode}", flush=True)
 
 
+def stage_serve_pipe():
+    """ISSUE 15: on-chip sync-vs-pipelined serve A/B — the paired
+    continuous-vs-pipelined offered-load sweep
+    (`bench_decima.bench_serve_scale`, round-17 protocol: same seeded
+    schedule per point, arms interleaved rep-by-rep, medians
+    compared) at chip scale, written as paired `serve_scale` rows +
+    artifacts/serve_pipe_chip.json. At this stage's defaults
+    (SERVE_SCALE_GROUPS=4) the two arms are two serve ARCHITECTURES:
+    the continuous front on the r13 single-group store vs the
+    pipelined front on its own 4-group depth-4 store — the grouped
+    layout is part of what pipelining needs on a chip, so it rides
+    the measured arm (set SERVE_SCALE_GROUPS=1 for a same-store
+    front-only A/B, as the CPU artifact runs).
+    Runs ENTIRELY in a subprocess, gate included (counting devices
+    claims the client); a chipless host prints an explicit
+    `[serve-pipe] UNAVAILABLE` marker and exits 0 — the watcher log
+    must distinguish "no window" from "never ran". The CPU A/B at the
+    default scale lives in artifacts/serve_scale_r17.json / PERF.md
+    round 17; this stage is the on-chip confirmation slot, queued
+    behind stages 13-16. The pipeline matters MORE on a real chip:
+    device compute and host work run on different silicon there, so
+    the overlap the CPU A/B can only approximate is real concurrency.
+    Chip-scale knobs (4 groups x 32 slots under a 256-session
+    capacity, tighter SLO) default below; every one is
+    env-overridable."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    if _client_held():
+        print("[serve-pipe] parent process already holds a device "
+              "client; run stage 17 as its own invocation", flush=True)
+        return
+    repo = osp.dirname(osp.abspath(__file__))
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from sparksched_tpu.config import (\n"
+        "    enable_compilation_cache, honor_jax_platforms_env,\n"
+        "    use_fast_prng,\n"
+        ")\n"
+        "honor_jax_platforms_env()\n"
+        "enable_compilation_cache()\n"
+        "if os.environ.get('BENCH_PRNG', 'rbg') == 'rbg':\n"
+        "    use_fast_prng()\n"
+        "import jax\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    print('[serve-pipe] UNAVAILABLE: cpu backend only; the "
+        "chip-scale sync-vs-pipelined serve A/B rows need a chip "
+        "window (the CPU A/B is recorded in "
+        "artifacts/serve_scale_r17.json and PERF.md round 17)', "
+        "flush=True)\n"
+        "    sys.exit(0)\n"
+        "import bench_decima\n"
+        "bench_decima.bench_serve_scale(\n"
+        "    artifact='artifacts/serve_pipe_chip.json')\n"
+    )
+    env = os.environ | {
+        # chip-scale paired A/B: the pipelined arm on 4 slot groups x
+        # 32 slots (128 hot) under a 256-session capacity, the sync
+        # arm on the r13 single-group layout (two architectures — see
+        # the docstring), the sweep pushed past the chip's serving
+        # capacity so both knees are on the curve
+        "SERVE_SCALE_FRONTS": os.environ.get(
+            "SERVE_SCALE_FRONTS", "continuous,pipelined"
+        ),
+        "SERVE_SCALE_GROUPS": os.environ.get(
+            "SERVE_SCALE_GROUPS", "4"
+        ),
+        "SERVE_SCALE_DEPTH": os.environ.get("SERVE_SCALE_DEPTH", "4"),
+        "SERVE_SCALE_CAPACITY": os.environ.get(
+            "SERVE_SCALE_CAPACITY", "256"
+        ),
+        "SERVE_SCALE_HOT_CAPACITY": os.environ.get(
+            "SERVE_SCALE_HOT_CAPACITY", "128"
+        ),
+        "SERVE_SCALE_BATCH": os.environ.get("SERVE_SCALE_BATCH", "16"),
+        "SERVE_SCALE_TENANTS": os.environ.get(
+            "SERVE_SCALE_TENANTS", "64"
+        ),
+        "SERVE_SCALE_REQUESTS": os.environ.get(
+            "SERVE_SCALE_REQUESTS", "2000"
+        ),
+        "SERVE_SCALE_OFFERED": os.environ.get(
+            "SERVE_SCALE_OFFERED", "250,500,1000,2000,4000"
+        ),
+        "SERVE_SCALE_SLO_MS": os.environ.get(
+            "SERVE_SCALE_SLO_MS", "25"
+        ),
+        "SERVE_SCALE_AB_REPS": os.environ.get(
+            "SERVE_SCALE_AB_REPS", "3"
+        ),
+        # the on-chip window is for the front A/B; the online arm has
+        # its own CPU artifact and would double the window
+        "SERVE_SCALE_ONLINE": os.environ.get("SERVE_SCALE_ONLINE", "0"),
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, timeout=3600, env=env,
+    )
+    print(f"[serve-pipe] subprocess rc={r.returncode}", flush=True)
+
+
 # ---------------------------------------------------------------------------
 # stage-completion ledger (ISSUE 9 preemption safety)
 # ---------------------------------------------------------------------------
@@ -815,6 +918,7 @@ STAGES = {
     "14": ("serving-latency capture", stage_serve_latency),
     "15": ("serve-scale open-loop capture", stage_serve_scale),
     "16": ("continuous-batching A/B capture", stage_serve_cb),
+    "17": ("pipelined-serve A/B capture", stage_serve_pipe),
 }
 
 
@@ -848,10 +952,11 @@ if __name__ == "__main__":
                 print("chip unavailable; aborting session", flush=True)
                 break
         finally:
-            # 7, 12, 13, 14, 15 and 16 run in subprocesses and 10 is
-            # CPU-subprocess-only: none takes the in-process device
+            # 7, 12, 13, 14, 15, 16 and 17 run in subprocesses and 10
+            # is CPU-subprocess-only: none takes the in-process device
             # client
-            if p not in ("7", "10", "12", "13", "14", "15", "16"):
+            if p not in ("7", "10", "12", "13", "14", "15", "16",
+                         "17"):
                 _mark_client_held()
             if ledger_path:
                 ledger[p] = {

@@ -37,6 +37,8 @@ rm -f /tmp/serve_latency_done
 rm -f /tmp/serve_scale_done
 # ... and for the continuous-batching A/B capture (stage 16, ISSUE 13)
 rm -f /tmp/serve_cb_done
+# ... and for the pipelined-serve A/B capture (stage 17, ISSUE 15)
+rm -f /tmp/serve_pipe_done
 # stage-completion ledger (ISSUE 9): per-LIFETIME like the markers
 # above — a restarted watcher must re-run its multi-stage sessions, not
 # inherit a previous lifetime's completions (the ledger's job is
@@ -289,6 +291,24 @@ print('ALIVE')
       echo "serve-cb rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
       grep -q '"backend": "tpu"' /tmp/serve_cb_last.log \
         && touch "$SERVE_CB_MARK"
+    fi
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time pipelined-serve A/B capture (ISSUE 15, stage 17): the
+    # paired sync-vs-pipelined offered-load sweep at chip scale —
+    # continuous front on the r13 single-group store vs the pipelined
+    # front on its own 4-group store (two serve architectures; see
+    # the stage docstring) — the on-chip partner of the CPU A/B in
+    # artifacts/serve_scale_r17.json / PERF.md round 17, queued behind
+    # the 13-16 slots. Once per watcher lifetime; marked done only
+    # when a TPU-backed row landed (an UNAVAILABLE marker means no
+    # window yet — retry next loop, like the earlier slots).
+    SERVE_PIPE_MARK=/tmp/serve_pipe_done
+    if [ ! -f "$SERVE_PIPE_MARK" ]; then
+      timeout -k 60 3700 python scripts_chip_session.py 17 \
+        | tee /tmp/serve_pipe_last.log
+      echo "serve-pipe rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q '"backend": "tpu"' /tmp/serve_pipe_last.log \
+        && touch "$SERVE_PIPE_MARK"
     fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable

@@ -210,6 +210,19 @@ BUDGETS: dict[str, Budget] = {
     "serve_decide_batch_record": Budget(
         eqn_lo=6000, eqn_hi=17410, gather_hi=339, scatter_hi=88,
     ),
+    # ISSUE 15: the GROUP-shaped serve program (the pipelined store's
+    # [hot_capacity/groups] lowering — serve/aot.py
+    # `serve_decide_batch_group`), pinned 2026-08-04 at 12853/251/65:
+    # byte-identical counts to `serve_decide_batch`, which is the
+    # acceptance bar — slot groups are host-side call routing, and a
+    # "grouped" program that started diverging structurally from the
+    # ungrouped one (extra copies, a gather over groups) would breach
+    # here first. All pre-ISSUE-15 serve programs re-measured
+    # byte-identical in the same PR (the take_slot/write_slot
+    # refactor moved code, not equations).
+    "serve_decide_batch_group": Budget(
+        eqn_lo=6000, eqn_hi=17400, gather_hi=339, scatter_hi=88,
+    ),
 }
 
 
@@ -562,7 +575,8 @@ def program_callables(names: tuple[str, ...] | None = None
 
     if want is None or want & {
         "serve_decide", "serve_decide_batch",
-        "serve_decide_batch_sharded",
+        "serve_decide_batch_sharded", "serve_decide_record",
+        "serve_decide_batch_record", "serve_decide_batch_group",
     }:
         # ISSUE 10/13: the AOT decision service's programs (serving
         # store capacity 8, micro-batch width 4 at audit scale; the

@@ -27,6 +27,17 @@ report use):
   `renderer.py` (moved here from tests/test_obs.py) — host-loop output
   goes through `obs.runlog` (`emit` / the JSONL sink) so it stays
   machine-readable and console-consistent.
+- ``serve-host-sync`` (ISSUE 15): in the serve pump hot path
+  (`serve/session.py`), a blocking host sync — `jax.device_get`,
+  `block_until_ready`, or an eager `np.asarray` on a device array —
+  is a violation OUTSIDE the harvest/trace boundary
+  (`SERVE_HARVEST_FUNCS`). The pipelined front exists because one
+  stray sync in dispatch/admission serializes the whole in-flight
+  window; this rule makes that regression a CI failure instead of a
+  p99 surprise. The file is a HOST_FILE (the generic host-sync rule
+  deliberately exempts it — handing back concrete decisions IS its
+  product), so this rule is the narrow replacement: syncs may live in
+  the harvest stage and the trace stamps, nowhere else.
 
 Scoping is declarative data below. Known-host-side code is exempted
 there (visible in one place), and a line-level escape hatch exists for
@@ -95,6 +106,24 @@ HOST_SYNC_EXEMPT_FUNCS: dict[str, tuple[str, ...]] = {
     "_cleanup": ("trainers/trainer.py",),
     "schedule": ("schedulers/",),
 }
+
+# serve-host-sync (ISSUE 15) scoping: the serve pump hot path, and the
+# functions forming its sanctioned harvest/trace boundary — the ONLY
+# places in those files where a blocking device sync
+# (device_get / block_until_ready / eager np.asarray on device
+# buffers) is allowed. Everything else in the file is
+# dispatch/admission code the pipelined front needs sync-free.
+SERVE_PUMP_FILES = frozenset({"serve/session.py"})
+SERVE_HARVEST_FUNCS = frozenset({
+    # the synchronous serve path's materialization (it IS a harvest)
+    "_served",
+    # the pipelined harvest stage (pop_ready = the device half,
+    # finalize_call = the host half) + the background harvester
+    "harvest", "pop_ready", "finalize_call", "_materialize",
+    "_harvester_loop",
+    # the deferred page-out drain (the non-blocking pager's tail)
+    "_drain_writebacks",
+})
 
 
 def _func_exempt(relpath: str, func_stack: list[str],
@@ -185,6 +214,7 @@ class _Linter(ast.NodeVisitor):
         self.sync_exempt_file = (
             top in HOST_SYNC_EXEMPT_DIRS or self.host_file
         )
+        self.serve_pump = relpath in SERVE_PUMP_FILES
         self.print_exempt = relpath == "renderer.py"
 
     # -- helpers ------------------------------------------------------
@@ -247,15 +277,33 @@ class _Linter(ast.NodeVisitor):
             return
 
         # host-sync (package-wide minus the sanctioned host loop)
-        if (
+        is_sync_call = (
             name in ("jax.device_get", "jax.block_until_ready")
             or (isinstance(fn, ast.Attribute)
                 and fn.attr == "block_until_ready")
-        ) and not self._sync_exempt():
+        )
+        if is_sync_call and not self._sync_exempt():
             self._emit(
                 "host-sync", node,
                 f"{name}() outside obs//bench — a device sync in "
                 "collection/update code serializes dispatch",
+            )
+
+        # serve-host-sync (ISSUE 15): blocking syncs in the serve pump
+        # hot path are confined to the harvest/trace boundary — a
+        # stray one in dispatch/admission code serializes the whole
+        # in-flight window
+        if self.serve_pump and (
+            is_sync_call or name == "numpy.asarray"
+        ) and not any(
+            f in SERVE_HARVEST_FUNCS for f in self.func_stack
+        ):
+            self._emit(
+                "serve-host-sync", node,
+                f"{name or 'block_until_ready'}() in the serve pump "
+                "hot path outside the harvest/trace boundary "
+                "(SERVE_HARVEST_FUNCS) — a blocking sync here "
+                "serializes the pipelined in-flight window",
             )
 
         if not self.in_hot:

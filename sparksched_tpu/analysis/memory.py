@@ -166,6 +166,13 @@ MEM_BUDGETS: dict[str, MemBudget] = {
     # (the hot-swap params-as-argument refactor moved no bytes).
     "serve_decide_record": MemBudget(temp_hi=81 * MB),
     "serve_decide_batch_record": MemBudget(temp_hi=442 * MB),
+    # ISSUE 15 group-shaped store program (pinned 2026-08-04):
+    # 324.6 MB vs 325.5 at the full audit store — the temp bytes are
+    # batch-axis-dominated (the width-K policy eval), so halving the
+    # STORE axis moves almost nothing. The band pins that a grouped
+    # lowering never starts materializing cross-group state (a
+    # concatenated all-groups view would double here immediately).
+    "serve_decide_batch_group": MemBudget(temp_hi=440 * MB),
 }
 
 # lane counts the advisor sweeps (the bench's production range; 1024
@@ -374,7 +381,8 @@ def audit_memory(
     # bounded by max_batch, not a throughput axis swept to HBM
     # capacity — the hot-set axis has its own advisor,
     # obs.memory.hot_set_fit.)
-    for sname in ("serve_decide_batch", "serve_decide_batch_sharded"):
+    for sname in ("serve_decide_batch", "serve_decide_batch_sharded",
+                  "serve_decide_batch_group"):
         if names is not None and sname not in names:
             continue
         from ..serve.aot import SERVE_AUDIT_BATCH

@@ -155,6 +155,11 @@ SERVE_KEYS = frozenset({
     # ISSUE 14: the online learning loop's serve-side knobs
     "record",  # compile the record-on programs (per-decision StoredObs)
     "pager_aware",  # continuous front: prefer hot sessions in batches
+    # ISSUE 15: pipelined serve execution
+    "groups",  # independently-donated slot groups (in-flight width)
+    "depth",  # `front: pipelined` in-flight window depth (default: groups)
+    "harvester",  # background harvester thread for output materialization
+    "prefetch",  # pipelined front: page predicted-next sessions ahead
 })
 
 ONLINE_KEYS = frozenset({

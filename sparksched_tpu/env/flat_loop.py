@@ -133,6 +133,22 @@ def take_slot(store, i):
     return jax.tree_util.tree_map(lambda a: a[i], store)
 
 
+def write_slot(store, i, ls, drop: bool = False):
+    """`take_slot`'s scatter partner: write one session's `LoopState`
+    (or, with a vector index and [K]-stacked values, K sessions) back
+    into a [C]-stacked store at slot index `i`. With `drop`,
+    out-of-range indices drop instead of clamping (the batched serve
+    program's padding-lane discipline). One definition shared by the
+    serve programs' scatter-back (`serve/aot.py`) and the session
+    store's slot writer / pager page-in (`serve/session.py`), so a
+    paged or group-routed write is by construction the same update the
+    compiled program performs."""
+    kw = {"mode": "drop"} if drop else {}
+    return jax.tree_util.tree_map(
+        lambda s, v: s.at[i].set(v, **kw), store, ls
+    )
+
+
 def init_loop_state(state: EnvState) -> LoopState:
     n = state.exec_job.shape[0]
     return LoopState(

@@ -65,8 +65,13 @@ class annotate:
 #
 # The serving path's Dapper-style walk: a trace id minted at `Ticket`
 # creation, one perf_counter stamp per phase as the request moves
-# submit -> batch_admit -> dispatch -> device_compute -> scatter_back
-# -> reply. Host-side only — the compiled serve programs are untouched
+# submit -> batch_admit -> dispatch -> harvest -> device_compute ->
+# scatter_back -> reply. `harvest` (ISSUE 15) is the instant the host
+# STARTS materializing the call — immediately after dispatch on the
+# synchronous front, one full in-flight residency later under the
+# pipelined front (dispatch -> harvest is the pipeline overlap the
+# span exists to show).
+# Host-side only — the compiled serve programs are untouched
 # (the analysis registry pins them byte-identical), and the host
 # phases bracket the device work: `dispatch` is the instant the
 # compiled call is issued, `device_compute` when its outputs are ready
@@ -78,7 +83,7 @@ class annotate:
 # ---------------------------------------------------------------------------
 
 SPAN_ORDER = (
-    "submit", "batch_admit", "dispatch", "device_compute",
+    "submit", "batch_admit", "dispatch", "harvest", "device_compute",
     "scatter_back", "reply",
 )
 

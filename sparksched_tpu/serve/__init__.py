@@ -20,6 +20,7 @@ from .aot import (
 from .loadgen import generate_arrivals, run_open_loop
 from .session import (
     ContinuousBatcher,
+    InFlightCall,
     MicroBatcher,
     ServeResult,
     SessionError,
@@ -39,6 +40,7 @@ __all__ = [
     "generate_arrivals",
     "run_open_loop",
     "ContinuousBatcher",
+    "InFlightCall",
     "MicroBatcher",
     "ServeResult",
     "SessionError",

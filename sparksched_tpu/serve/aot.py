@@ -74,6 +74,7 @@ from ..env.flat_loop import (
     apply_and_drain,
     aux_action_fields,
     take_slot,
+    write_slot,
 )
 from ..env.health import reward_health, state_health
 from ..env.observe import observe
@@ -222,9 +223,7 @@ def serve_decide_fn(
                 params, bank, policy_fn, model_params, ls, key,
                 force_stage, force_nexec, use_force, kn, record=record,
             )
-            store2 = jax.tree_util.tree_map(
-                lambda s, v: s.at[slot].set(v), store, ls2
-            )
+            store2 = write_slot(store, slot, ls2)
             if shard is not None:
                 store2 = jax.lax.with_sharding_constraint(store2, shard)
         return store2, out
@@ -311,9 +310,7 @@ def serve_decide_batch_fn(
             )
             # padding slots (index C) drop instead of scattering the
             # clamped lane's speculative update back over a real session
-            store2 = jax.tree_util.tree_map(
-                lambda s, v: s.at[slots].set(v, mode="drop"), store, ls2
-            )
+            store2 = write_slot(store, slots, ls2, drop=True)
             if shard is not None:
                 store2 = jax.lax.with_sharding_constraint(store2, shard)
         return store2, out
@@ -366,6 +363,14 @@ def abstract_like(tree, keep_sharding: bool = False):
 
 SERVE_AUDIT_CAPACITY = 8
 SERVE_AUDIT_BATCH = 4
+# ISSUE 15: the GROUP-shaped store program — the audit store split
+# into 2 slot groups, i.e. the same serve_decide_batch function
+# lowered at the [capacity/2] group width the pipelined store
+# compiles. Groups are a host-side routing construct: the traced
+# program must be IDENTICAL in structure to the ungrouped one (only
+# buffer widths change), and the registry pin proves it stays that
+# way — grouping adds zero equations, zero gathers, zero scatters.
+SERVE_AUDIT_GROUPS = 2
 
 
 def serve_callables(
@@ -438,6 +443,26 @@ def serve_callables(
                 params, bank, bpol, batch, shard=shard
             ),
             (store, mp, slots, key),
+        ),
+        # ISSUE 15: the group-shaped program the pipelined store
+        # compiles — serve_decide_batch at the [capacity/groups]
+        # group width. Same function, smaller store axis: the pin
+        # proves grouping is pure host-side routing (eqn/gather/
+        # scatter counts identical to serve_decide_batch; only the
+        # temp-byte budget shrinks with the store axis).
+        "serve_decide_batch_group": (
+            serve_decide_batch_fn(params, bank, bpol, batch),
+            (
+                jax.tree_util.tree_map(
+                    lambda l: jax.ShapeDtypeStruct(
+                        (capacity // SERVE_AUDIT_GROUPS,)
+                        + tuple(l.shape[1:]),
+                        l.dtype,
+                    ),
+                    store,
+                ),
+                mp, slots, key,
+            ),
         ),
         # ISSUE 14: the record-on variants the online trajectory path
         # compiles (`SessionStore(record=True)`). Budgeted separately

@@ -81,16 +81,67 @@ dispatch -> device_compute -> scatter_back -> reply) emitted as runlog
 programs are untouched (the analysis registry pins their jaxprs
 byte-identical with instrumentation off).
 
+Pipelined execution (ISSUE 15): the pump loop above is synchronous —
+each compiled call is dispatched, then the host BLOCKS on its outputs
+(`np.asarray` syncs per leaf) and does all host work (ticket
+finishing, traces, learner feeding, pager round-trips) before the
+next admission, so the device idles during host work and the host
+idles during device compute. Three changes turn the front into a
+depth-D pipeline:
+
+- **slot groups**: the donated device store is split into `groups`
+  independently-donated `[hot_capacity/groups]`-stacked buffers.
+  Donation serializes consecutive calls on ONE buffer (call N+1's
+  input is call N's output); calls on different groups have no data
+  dependency, so up to G width-K calls can be in flight at once.
+  Group membership is static (a slot's group is `slot //
+  group_slots` forever), so the AOT lowering (one compiled program at
+  the [group_slots] shape, shared by every group), the dp sharding
+  (`P('dp')` on each group's leading axis) and the zero-recompile
+  param-swap contract are all preserved — one params version per
+  in-flight call, swaps applied at dispatch boundaries exactly as
+  before. A batch is served by ONE call and therefore lives in ONE
+  group (`decide_batch`/`dispatch_batch` reject cross-group sid
+  sets; the `ContinuousBatcher` forms per-group batches).
+- **async harvest**: `dispatch_batch(sids)` exploits JAX async
+  dispatch — it returns an `InFlightCall` holding the device output
+  futures immediately, and `harvest()` (drained on the front's next
+  poll, or by a background harvester thread behind the `harvester`
+  flag) performs the `np.asarray` materialization, health
+  application, collector feeding and ticket finishing later —
+  overlapping all host work with the next group's device compute.
+  Harvest order is dispatch order (FIFO), so per-session decision
+  order and the trajectory path's episode order are unchanged.
+- **non-blocking pager**: `_page_out` no longer blocks on
+  `jax.device_get` — the evicted slot is gathered by a small
+  compiled call into an independent device buffer (chaining on any
+  in-flight call instead of waiting for it) and the host
+  materialization is DEFERRED to the harvest stage
+  (`_drain_writebacks`). A page-in that finds its session's
+  write-back still in device form re-uses it directly — a
+  device-to-device round trip that never touches the host.
+  `prefetch(sid)` pages a predicted-next session into a FREE slot of
+  its group ahead of its batch (the `ContinuousBatcher`'s
+  pager-aware look-ahead drives it), never evicting for a
+  prediction.
+
+Dispatching with the SAME sequence of calls (same admission order)
+produces bit-identical decisions to the synchronous path — pipelining
+moves only WHEN host materialization happens, never what the device
+computes (test-pinned: rewards bit-equal vs the synchronous front).
+
 Config surface: the top-level `serve:` YAML block
 (`config.SERVE_KEYS`), validated loudly like the `health:`/`chaos:`
 blocks — a typo'd knob must fail, not silently serve with defaults.
-`front: continuous|linger` picks the batching front
+`front: continuous|linger|pipelined` picks the batching front
 (`front_from_config`); `hot_capacity` enables the pager; `shard_dp`
-shards the store over a dp mesh.
+shards the store over a dp mesh; `groups`/`depth`/`harvester`/
+`prefetch` are the pipelining knobs.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Any
@@ -101,7 +152,7 @@ import numpy as np
 
 from ..config import SERVE_KEYS, EnvParams
 from ..env import core
-from ..env.flat_loop import init_loop_state, take_slot
+from ..env.flat_loop import init_loop_state, take_slot, write_slot
 from ..obs.tracing import RequestTrace, annotate
 from ..workload.bank import WorkloadBank
 from .aot import (
@@ -170,14 +221,72 @@ class ServeResult:
         }
 
 
+class InFlightCall:
+    """One dispatched-but-unharvested compiled serve call (ISSUE 15).
+
+    `out` holds the call's DEVICE outputs (JAX async dispatch: futures,
+    not values); `host_out` is filled by whoever materializes first —
+    the background harvester thread or `SessionStore.harvest` itself.
+    `params_version` is the staleness stamp live at DISPATCH (the
+    hot-swap contract is per-call, unchanged by pipelining), `gens`
+    the per-session store generations at dispatch (a session closed
+    and re-created while its call was in flight must not have the
+    stale call's health/trajectory applied to its replacement).
+    `tickets` is the batching front's attachment point; `results` is
+    set at harvest."""
+
+    __slots__ = (
+        "sids", "group", "batched", "out", "host_out", "bg_failed",
+        "bg_claimed", "params_version", "gens", "spans", "tickets",
+        "results",
+    )
+
+    def __init__(self, sids, group, batched, out, params_version,
+                 gens, spans=None) -> None:
+        self.sids = list(sids)
+        self.group = int(group)
+        self.batched = bool(batched)
+        self.out = out
+        self.host_out = None
+        # set by the background harvester when ITS materialization
+        # attempt raised: the thread must not busy-spin retrying a
+        # poisoned call — the serving thread's harvest retries (and
+        # surfaces the error) instead
+        self.bg_failed = False
+        # set (under the store's condition lock) when the harvester
+        # thread starts materializing this call, so the serving
+        # thread WAITS for that copy instead of duplicating the full
+        # np.asarray tree conversion the thread exists to offload
+        self.bg_claimed = False
+        self.params_version = int(params_version)
+        self.gens = list(gens)
+        self.spans: dict[str, float] | None = spans
+        self.tickets: list[Ticket] | None = None
+        self.results: list[ServeResult] | None = None
+
+    def outputs_ready(self) -> bool:
+        """Whether the device finished this call (no host sync — JAX's
+        per-buffer readiness flag)."""
+        if self.host_out is not None:
+            return True
+        return all(
+            l.is_ready() for l in jax.tree_util.tree_leaves(self.out)
+            if hasattr(l, "is_ready")
+        )
+
+
 class SessionStore:
     """Persistent session store over donated AOT programs: `capacity`
     sessions over `hot_capacity` device slots (idle sessions page to
-    host RAM when the two differ), optionally sharded over a `dp`
+    host RAM when the two differ), optionally split into `groups`
+    independently-donated slot groups so up to G compiled calls can be
+    in flight at once (ISSUE 15), optionally sharded over a `dp`
     mesh. Not thread-safe by design: a serving front owns one store
     per worker (the donation discipline — exactly one live reference
-    to the store buffer — does not compose with concurrent
-    mutation)."""
+    to each group buffer — does not compose with concurrent
+    mutation). The optional background `harvester` thread only
+    materializes device outputs (read-only) — it never mutates the
+    store."""
 
     def __init__(
         self,
@@ -187,6 +296,8 @@ class SessionStore:
         capacity: int = 64,
         *,
         hot_capacity: int | None = None,
+        groups: int = 1,
+        harvester: bool = False,
         mesh=None,
         max_batch: int = 8,
         deterministic: bool = True,
@@ -206,16 +317,26 @@ class SessionStore:
                 f"hot_capacity={hot} must be in [1, capacity="
                 f"{capacity}]"
             )
-        if not 1 <= max_batch <= hot:
+        self.groups = int(groups)
+        if self.groups < 1 or hot % self.groups != 0:
             raise ValueError(
-                f"max_batch={max_batch} must be in [1, hot_capacity="
-                f"{hot}]"
+                f"groups={groups} must be >= 1 and divide "
+                f"hot_capacity={hot} (static group membership: each "
+                "group is an equal, independently-donated slot stack)"
             )
-        if mesh is not None and hot % mesh.size != 0:
+        gs = hot // self.groups
+        self.group_slots = gs
+        if not 1 <= max_batch <= gs:
             raise ValueError(
-                f"hot_capacity={hot} must divide evenly over the "
-                f"{mesh.size}-device mesh (each device holds "
-                "hot_capacity/dp slots)"
+                f"max_batch={max_batch} must be in [1, "
+                f"hot_capacity/groups={gs}] (a batch is ONE compiled "
+                "call and lives in ONE slot group)"
+            )
+        if mesh is not None and gs % mesh.size != 0:
+            raise ValueError(
+                f"hot_capacity/groups={gs} must divide evenly over "
+                f"the {mesh.size}-device mesh (each device holds "
+                "group_slots/dp slots per group)"
             )
         self.params = params
         self.bank = bank
@@ -276,23 +397,34 @@ class SessionStore:
             lambda k: init_loop_state(core.reset(params, bank, k))
         )
         self._write_slot = jax.jit(
-            lambda store, sid, ls: jax.tree_util.tree_map(
-                lambda s, v: s.at[sid].set(v), store, ls
-            ),
+            write_slot,
             donate_argnums=(0,) if donate else (),
+            static_argnames=("drop",),
         )
+        # the pager's page-out gather (take_slot — the serve programs'
+        # gather, so the paged copy is the exact served view). NOT
+        # donating: the group store stays live; the gather's output is
+        # an independent device buffer the harvest stage materializes
+        # later (the non-blocking pager, ISSUE 15)
+        self._take1 = jax.jit(take_slot)
 
-        # the [hot] device store starts as copies of one dummy reset
-        # episode; create() overwrites a slot with its own seeded reset
+        # the device store is `groups` independently-donated [gs]
+        # stacks, each starting as copies of one dummy reset episode;
+        # create() overwrites a slot with its own seeded reset. One AOT
+        # lowering at the [gs] shape serves every group (static group
+        # membership — groups differ only in which buffer is passed).
         ls0 = self._reset1(jax.random.fold_in(self._base_key, 2**19))
-        store = jax.tree_util.tree_map(
-            lambda a: jnp.broadcast_to(
-                a, (self.hot_capacity,) + a.shape
-            ).copy(),
-            ls0,
+        group0 = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (gs,) + a.shape).copy(), ls0
         )
         if shard is not None:
-            store = jax.device_put(store, shard)
+            group0 = jax.device_put(group0, shard)
+        stores = [group0]
+        for _ in range(self.groups - 1):
+            g = jax.tree_util.tree_map(jnp.copy, group0)
+            if shard is not None:
+                g = jax.device_put(g, shard)
+            stores.append(g)
 
         # ---- AOT lowering + compile (the cold start) ----
         fn1 = serve_decide_fn(params, bank, pol, self.knobs,
@@ -301,7 +433,7 @@ class SessionStore:
             params, bank, bpol, self.max_batch, self.knobs,
             shard=shard, record=self.record,
         )
-        st_abs = abstract_like(store, keep_sharding=shard is not None)
+        st_abs = abstract_like(stores[0], keep_sharding=shard is not None)
         mp_abs = abstract_like(
             self._model_params, keep_sharding=mesh is not None
         )
@@ -319,27 +451,55 @@ class SessionStore:
         self.compile_secs = {"decide": secs1, "decide_batch": secsk}
 
         # host-side session/slot bookkeeping: sids are public handles,
-        # slots are device positions. Both free pools are maintained
-        # free-lists (pop/append), so create() is O(1) at any
-        # capacity — the paging work needs capacities past 64, where
-        # the old linear free-slot scan would start to show.
+        # slots are device positions (GLOBAL ids: group = slot //
+        # group_slots, local = slot % group_slots — static membership).
+        # Both free pools are maintained free-lists (pop/append), so
+        # create() is O(1) at any capacity — the paging work needs
+        # capacities past 64, where the old linear free-slot scan would
+        # start to show.
         self._live = np.zeros(self.capacity, bool)
         self._quarantined = np.zeros(self.capacity, bool)
         self._slot_of = np.full(self.capacity, -1, np.int32)
         self._sid_of = np.full(self.hot_capacity, -1, np.int32)
+        # sid -> static group (paged stores keep a cold session's group
+        # across page-outs, so it always returns to its own group)
+        self._group_of = np.full(self.capacity, -1, np.int32)
+        # per-sid store generation (ISSUE 15): sids are reused by
+        # create(), and with calls in flight a session can be closed
+        # and re-created before its call harvests — health application
+        # and collector feeding are gated on the generation matching,
+        # so a stale in-flight decision never poisons the replacement
+        self._gen = np.zeros(self.capacity, np.int64)
         # init [cap-1 .. 0] so pop() hands out 0, 1, 2, ... on a fresh
-        # store (the r10 smallest-first order), then LIFO reuse. The
-        # slot free-list exists only under paging — the unpaged store
-        # maps sid == slot identically and must not carry a stale
-        # "every slot free" list beside it
+        # store (the r10 smallest-first order), then LIFO reuse. Slot
+        # free-lists are PER GROUP and exist only under paging or
+        # grouping — the single-group unpaged store maps sid == slot
+        # identically and must not carry a stale "every slot free"
+        # list beside it
         self._free_sids = list(range(self.capacity - 1, -1, -1))
-        self._free_slots = (
-            list(range(self.hot_capacity - 1, -1, -1))
-            if self.hot_capacity < self.capacity else []
+        self._dynamic_slots = (
+            self.groups > 1 or self.hot_capacity < self.capacity
         )
+        self._free_slots: list[list[int]] = [
+            (list(range((g + 1) * gs - 1, g * gs - 1, -1))
+             if self._dynamic_slots else [])
+            for g in range(self.groups)
+        ]
         self._cold: dict[int, Any] = {}
+        # cold sids whose page-out gather is still a device buffer:
+        # drained (np.asarray'd) at harvest, or reused device-side by a
+        # page-in that arrives first (FIFO, so the oldest write-back —
+        # the one most likely ready — materializes first)
+        self._wb_pending: deque[int] = deque()
         self._last_use = np.zeros(self.hot_capacity, np.int64)
         self._tick = 0
+        # the in-flight window (ISSUE 15): dispatched-but-unharvested
+        # compiled calls, FIFO. `wall_split` accumulates the host
+        # loop's two wall components — time to DISPATCH compiled calls
+        # (async, returns futures) vs time BLOCKED materializing
+        # device outputs — the split bench_serve_latency reports.
+        self._inflight: deque[InFlightCall] = deque()
+        self.wall_split = {"dispatch_s": 0.0, "blocked_host_s": 0.0}
         self.stats = {
             "serve_decisions": 0,
             "serve_batched_decisions": 0,
@@ -353,48 +513,90 @@ class SessionStore:
             "serve_param_swaps": 0,
             "serve_param_rollbacks": 0,
             "serve_param_version": 0,
+            "serve_inflight_peak": 0,
+            "serve_prefetches": 0,
         }
 
         # ---- warmup: one call per program, so the warm path never
         # pays a first-dispatch (executable load, buffer layout) cost.
-        # Slot contents are dummies here; create() re-seeds slots.
-        self._store = store
+        # Slot contents are dummies here; create() re-seeds slots. One
+        # warm call per PROGRAM suffices for every group (the groups
+        # share the two compiled executables).
+        self._stores = stores
         t0 = time.perf_counter()
-        self._store, _ = self._call1(
-            _i32(0), _i32(-1), _i32(0), jnp.bool_(False)
+        self._stores[0], _ = self._call1(
+            0, _i32(0), _i32(-1), _i32(0), jnp.bool_(False)
         )
-        self._store, _ = self._callk(
-            jnp.full((self.max_batch,), self.hot_capacity, _i32)
+        self._stores[0], _ = self._callk(
+            0, jnp.full((self.max_batch,), gs, _i32)
         )
-        jax.block_until_ready(self._store.mode)
+        # cold-start fence, not the pump hot path (ISSUE 15 lint rule)
+        jax.block_until_ready(self._stores[0].mode)  # analysis: allow(serve-host-sync)
         self.warmup_secs = time.perf_counter() - t0
         # reset warmup's mutation of slot 0 back to a clean dummy
-        self._store = self._write_slot(self._store, _i32(0), ls0)
+        self._stores[0] = self._write_slot(self._stores[0], _i32(0), ls0)
+
+        # the optional background harvester (ISSUE 15, `harvester:`
+        # config key): materializes the oldest in-flight call's device
+        # outputs off the serving thread, so `harvest()` finds them
+        # host-ready. Daemon — it holds no store mutation rights.
+        self._harvest_cv = threading.Condition()
+        self._harvester_stop = False
+        self._harvester: threading.Thread | None = None
+        if harvester:
+            self._harvester = threading.Thread(
+                target=self._harvester_loop, daemon=True,
+                name="serve-harvester",
+            )
+            self._harvester.start()
 
     # -- compiled-call plumbing -------------------------------------------
+
+    @property
+    def _store(self):
+        """The single-group device store — the pre-ISSUE-15 attribute,
+        kept for the G == 1 configuration (tests and callers poke slot
+        state through it). Grouped stores expose `_stores`."""
+        if self.groups != 1:
+            raise AttributeError(
+                "grouped store (groups > 1): use _stores[g]"
+            )
+        return self._stores[0]
+
+    @_store.setter
+    def _store(self, value) -> None:
+        if self.groups != 1:
+            raise AttributeError(
+                "grouped store (groups > 1): use _stores[g]"
+            )
+        self._stores[0] = value
 
     def _next_key(self) -> jax.Array:
         self._calls += 1
         return jax.random.fold_in(self._base_key, self._calls)
 
-    def _call1(self, slot, fstage, fnexec, use_force):
+    def _call1(self, group, local, fstage, fnexec, use_force):
         return self._c1(
-            self._store, self._model_params, slot, self._next_key(),
-            fstage, fnexec, use_force,
+            self._stores[group], self._model_params, local,
+            self._next_key(), fstage, fnexec, use_force,
         )
 
-    def _callk(self, slots):
+    def _callk(self, group, locals_):
         return self._ck(
-            self._store, self._model_params, slots, self._next_key()
+            self._stores[group], self._model_params, locals_,
+            self._next_key(),
         )
 
-    def _served(self, call):
-        """Run one compiled serve call and hand back host-side outputs.
-        With `trace` on, additionally stamp the call's phase
-        boundaries into `last_spans`: `dispatch` (the compiled call is
-        issued), `device_compute` (its outputs are ready),
-        `scatter_back` (the host holds concrete values). The off path
-        is byte-identical to the uninstrumented round-13 behavior."""
+    def _served(self, group, call):
+        """Run one compiled serve call SYNCHRONOUSLY and hand back
+        host-side outputs. With `trace` on, additionally stamp the
+        call's phase boundaries into `last_spans`: `dispatch` (the
+        compiled call is issued), `harvest` (the host starts
+        materializing — immediate on this synchronous path),
+        `device_compute` (outputs ready), `scatter_back` (the host
+        holds concrete values). The off path is byte-identical to the
+        uninstrumented round-13 behavior plus two clock reads for the
+        dispatch/blocked wall split."""
         # host materialization is per-LEAF np.asarray (each conversion
         # syncs on its buffer) rather than jax.device_get: measured
         # ~3x cheaper on the serve outputs, which matters once the
@@ -408,53 +610,119 @@ class SessionStore:
             # stale spans from a previously-traced window must never
             # merge into a later request's trace
             self.last_spans = None
-            self._store, out = call()
-            return to_host(out)
+            t0 = time.perf_counter()
+            self._stores[group], out = call()
+            t1 = time.perf_counter()
+            out = to_host(out)
+            self.wall_split["dispatch_s"] += t1 - t0
+            self.wall_split["blocked_host_s"] += (
+                time.perf_counter() - t1
+            )
+            # this call IS the synchronous harvest: drain any pending
+            # page-out write-backs whose device work finished, so
+            # deferred gathers never accumulate HBM across a window
+            self._drain_writebacks()
+            return out
         t_dispatch = time.perf_counter()
-        self._store, out = call()
+        self._stores[group], out = call()
+        t_harvest = time.perf_counter()
         jax.block_until_ready(out)
         t_compute = time.perf_counter()
         out = to_host(out)
         t_scatter = time.perf_counter()
+        self.wall_split["dispatch_s"] += t_harvest - t_dispatch
+        self.wall_split["blocked_host_s"] += t_scatter - t_harvest
+        self._drain_writebacks()
         self.last_spans = {
             "dispatch": t_dispatch,
+            "harvest": t_harvest,
             "device_compute": t_compute,
             "scatter_back": t_scatter,
         }
         return out
 
-    # -- the hot/cold pager (ISSUE 13) ------------------------------------
+    # -- the hot/cold pager (ISSUE 13; non-blocking since ISSUE 15) -------
+
+    def session_group(self, sid: int) -> int:
+        """The session's STATIC slot group (0 on a single-group
+        store): its resident slot's group, or the group it was
+        assigned at create (kept across page-outs — a cold session
+        always returns to its own group)."""
+        if self.groups == 1:
+            return 0
+        slot = int(self._slot_of[sid])
+        return slot // self.group_slots if slot >= 0 else int(
+            self._group_of[sid]
+        )
+
+    def has_free_slot(self, group: int) -> bool:
+        """Whether `group` has an un-evicting slot available — the
+        prefetch gate (a prediction must never evict a resident)."""
+        return bool(self._free_slots[group])
 
     def _page_out(self, slot: int) -> None:
-        """Move one resident session's slot to host RAM (numpy pytree).
-        The host copy is the exact device view (`take_slot` — the same
-        gather the serve programs run), so page-out -> page-in is
-        bit-exact (test-pinned)."""
+        """Move one resident session's slot toward host RAM. The copy
+        is the exact device view (`take_slot` — the same gather the
+        serve programs run), so page-out -> page-in is bit-exact
+        (test-pinned). NON-BLOCKING (ISSUE 15): the gather is a
+        compiled call whose OUTPUT is an independent device buffer —
+        it chains behind any in-flight call on the group instead of
+        syncing on it — and the host materialization is deferred to
+        `_drain_writebacks` (the harvest stage). A page-in that
+        arrives before the drain re-uses the device copy directly."""
+        g, l = divmod(slot, self.group_slots)
         vsid = int(self._sid_of[slot])
-        self._cold[vsid] = jax.device_get(take_slot(self._store, slot))
+        self._cold[vsid] = self._take1(self._stores[g], _i32(l))
+        self._wb_pending.append(vsid)
         self._sid_of[slot] = -1
         self._slot_of[vsid] = -1
         self.stats["serve_page_outs"] += 1
         if self.metrics is not None:
             self.metrics.counter("serve_page_outs")
 
-    def _alloc_slot(self, pinned: set[int]) -> int:
-        """A free device slot, evicting if needed. Victim preference:
-        a quarantined resident first (never served again — the best
-        session to keep cold), then the least-recently-served live
-        session; `pinned` sids (the current batch) are never
-        evicted."""
-        if self.hot_capacity == self.capacity:
+    def _drain_writebacks(self, wait: bool = False) -> None:
+        """The deferred half of `_page_out`: convert pending page-out
+        gathers from device buffers to host numpy (freeing their HBM).
+        With `wait=False` only entries whose device work already
+        finished are drained (no host sync on the pump path — the
+        serve-host-sync lint rule's contract); `wait=True` drains
+        everything (harvest / teardown)."""
+        remaining: deque[int] = deque()
+        while self._wb_pending:
+            sid = self._wb_pending.popleft()
+            entry = self._cold.get(sid)
+            if entry is None:
+                continue  # paged back in device-side, or closed
+            leaves = jax.tree_util.tree_leaves(entry)
+            ready = all(
+                l.is_ready() for l in leaves if hasattr(l, "is_ready")
+            )
+            if ready or wait:
+                self._cold[sid] = jax.tree_util.tree_map(
+                    np.asarray, entry
+                )
+            else:
+                remaining.append(sid)
+        self._wb_pending = remaining
+
+    def _alloc_slot(self, group: int, pinned: set[int]) -> int:
+        """A free device slot in `group`, evicting within the group if
+        needed. Victim preference: a quarantined resident first (never
+        served again — the best session to keep cold), then the
+        least-recently-served live session; `pinned` sids (the current
+        batch) are never evicted."""
+        if not self._dynamic_slots:
             raise AssertionError("unpaged store never allocates slots")
-        if self._free_slots:
-            return self._free_slots.pop()
+        if self._free_slots[group]:
+            return self._free_slots[group].pop()
+        gs = self.group_slots
         cands = [
-            s for s in range(self.hot_capacity)
+            s for s in range(group * gs, (group + 1) * gs)
             if self._sid_of[s] >= 0 and int(self._sid_of[s])
             not in pinned
         ]
         assert cands, (
-            "no evictable slot — max_batch <= hot_capacity makes this "
+            "no evictable slot — max_batch <= group_slots makes this "
             "unreachable"
         )
         quar = [s for s in cands if self._quarantined[self._sid_of[s]]]
@@ -464,24 +732,51 @@ class SessionStore:
         self._page_out(victim)
         return victim
 
+    def _pick_group(self) -> int:
+        """The slot group a fresh session joins (its STATIC home):
+        the group with the most free slots, so concurrent in-flight
+        windows see balanced occupancy; when every hot set is full,
+        the group with the fewest live sessions (eviction pressure
+        balances too). Deterministic tie-break toward lower index."""
+        best = max(
+            range(self.groups),
+            key=lambda g: (len(self._free_slots[g]), -g),
+        )
+        if self._free_slots[best]:
+            return best
+        counts = [0] * self.groups
+        for sid in range(self.capacity):
+            if self._live[sid] and self._group_of[sid] >= 0:
+                counts[int(self._group_of[sid])] += 1
+        return min(range(self.groups), key=lambda g: (counts[g], g))
+
+    def _page_in(self, sid: int, slot: int) -> None:
+        """Write the session's cold copy into `slot`. The copy may
+        still be a device buffer (a page-out the harvest stage has not
+        drained yet): it is consumed directly — a device-to-device
+        round trip that never touches the host."""
+        g, l = divmod(slot, self.group_slots)
+        self._stores[g] = self._write_slot(
+            self._stores[g], _i32(l), self._cold.pop(sid)
+        )
+        self._slot_of[sid] = slot
+        self._sid_of[slot] = sid
+        self.stats["serve_page_ins"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("serve_page_ins")
+
     def _ensure_hot(self, sids: list[int]) -> list[int]:
-        """Device slots for `sids`, paging cold sessions in (and idle
-        ones out) as needed; bumps the LRU clock of every touched
+        """Device slots (GLOBAL ids) for `sids` — which must share one
+        slot group — paging cold sessions in (and idle ones out, within
+        the group) as needed; bumps the LRU clock of every touched
         slot."""
         pinned = set(sids)
         slots = []
         for sid in sids:
             slot = int(self._slot_of[sid])
             if slot < 0:
-                slot = self._alloc_slot(pinned)
-                self._store = self._write_slot(
-                    self._store, _i32(slot), self._cold.pop(sid)
-                )
-                self._slot_of[sid] = slot
-                self._sid_of[slot] = sid
-                self.stats["serve_page_ins"] += 1
-                if self.metrics is not None:
-                    self.metrics.counter("serve_page_ins")
+                slot = self._alloc_slot(self.session_group(sid), pinned)
+                self._page_in(sid, slot)
             self._tick += 1
             self._last_use[slot] = self._tick
             slots.append(slot)
@@ -489,6 +784,31 @@ class SessionStore:
             (self._sid_of >= 0).sum()
         )
         return slots
+
+    def prefetch(self, sid: int) -> bool:
+        """Page a predicted-next session into a FREE slot of its group
+        ahead of its batch (the `ContinuousBatcher` look-ahead drives
+        this, ISSUE 15). Never evicts for a prediction; returns True
+        when a page-in was issued. The write is async (`device_put`
+        via the compiled slot writer) — the pump never blocks on it."""
+        if not 0 <= sid < self.capacity or not self._live[sid]:
+            return False
+        if int(self._slot_of[sid]) >= 0:
+            return False  # already hot
+        group = self.session_group(sid)
+        if not self._free_slots[group]:
+            return False
+        slot = self._free_slots[group].pop()
+        self._page_in(sid, slot)
+        self._tick += 1
+        self._last_use[slot] = self._tick
+        self.stats["serve_prefetches"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("serve_prefetches")
+        self.stats["serve_sessions_hot"] = int(
+            (self._sid_of >= 0).sum()
+        )
+        return True
 
     def hot_set_advice(
         self,
@@ -510,7 +830,7 @@ class SessionStore:
 
         slot = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
-            self._store,
+            self._stores[0],
         )
         fixed = sum(
             aval_bytes(jax.ShapeDtypeStruct(l.shape, l.dtype))
@@ -650,19 +970,24 @@ class SessionStore:
             if seed is None
             else jax.random.PRNGKey(seed)
         )
-        if self.hot_capacity == self.capacity:
-            # unpaged store: identity sid == slot, the r10/r11 layout
+        if not self._dynamic_slots:
+            # single-group unpaged store: identity sid == slot, the
+            # r10/r11 layout
             slot = sid
         else:
-            slot = self._alloc_slot(set())
-        self._store = self._write_slot(
-            self._store, _i32(slot), self._reset1(k)
+            group = self._pick_group()
+            self._group_of[sid] = group
+            slot = self._alloc_slot(group, set())
+        g, l = divmod(slot, self.group_slots)
+        self._stores[g] = self._write_slot(
+            self._stores[g], _i32(l), self._reset1(k)
         )
         self._slot_of[sid] = slot
         self._sid_of[slot] = sid
         self._tick += 1
         self._last_use[slot] = self._tick
         self._live[sid] = True
+        self._gen[sid] += 1
         self.stats["serve_sessions_live"] = int(self._live.sum())
         self.stats["serve_sessions_hot"] = int(
             (self._sid_of >= 0).sum()
@@ -680,9 +1005,10 @@ class SessionStore:
         slot = int(self._slot_of[sid])
         if slot >= 0:
             self._sid_of[slot] = -1
-            if self.hot_capacity < self.capacity:
-                self._free_slots.append(slot)
+            if self._dynamic_slots:
+                self._free_slots[slot // self.group_slots].append(slot)
         self._slot_of[sid] = -1
+        self._group_of[sid] = -1
         self._cold.pop(sid, None)
         self._live[sid] = False
         self._quarantined[sid] = False
@@ -725,13 +1051,27 @@ class SessionStore:
         if self.collector is not None:
             self.collector.add(res)
 
+    def _batch_group(self, sids: list[int]) -> int:
+        """The ONE slot group a batch lives in — a batch is one
+        compiled call over one group's donated buffer. Cross-group sid
+        sets fail loudly (the group-aware front never forms them)."""
+        gset = {self.session_group(s) for s in sids}
+        if len(gset) > 1:
+            raise ValueError(
+                f"batch spans slot groups {sorted(gset)} — a batch is "
+                "ONE compiled call and must live in ONE group (the "
+                "ContinuousBatcher forms per-group batches)"
+            )
+        return gset.pop()
+
     def decide(self, sid: int) -> ServeResult:
         """One policy decision on the unbatched AOT path."""
         self._check_sid(sid)
         [slot] = self._ensure_hot([sid])
+        g, l = divmod(slot, self.group_slots)
         ver = self.params_version  # staleness stamp: live at dispatch
-        out = self._served(lambda: self._call1(
-            _i32(slot), _i32(-1), _i32(0), jnp.bool_(False)
+        out = self._served(g, lambda: self._call1(
+            g, _i32(l), _i32(-1), _i32(0), jnp.bool_(False)
         ))
         res = ServeResult(sid, out, None, batched=False,
                           params_version=ver, obs=out.obs)
@@ -746,9 +1086,10 @@ class SessionStore:
         policy's pick is overridden by the forced-action select)."""
         self._check_sid(sid)
         [slot] = self._ensure_hot([sid])
+        g, l = divmod(slot, self.group_slots)
         ver = self.params_version
-        out = self._served(lambda: self._call1(
-            _i32(slot), _i32(stage_idx), _i32(num_exec),
+        out = self._served(g, lambda: self._call1(
+            g, _i32(l), _i32(stage_idx), _i32(num_exec),
             jnp.bool_(True),
         ))
         res = ServeResult(sid, out, None, batched=False,
@@ -757,6 +1098,36 @@ class SessionStore:
         self._record_result(res)
         self.stats["serve_decisions"] += 1
         return res
+
+    def _batch_results(self, sids, out, ver, gens=None
+                       ) -> list[ServeResult]:
+        """Host results of one width-K call: one flatten per call (the
+        per-result obs are unflattened numpy views, not K tree_maps),
+        health applied and the collector fed per decision — gated on
+        the session generation still matching when `gens` is given
+        (the in-flight window can outlive a close/create pair)."""
+        obs_leaves = obs_tdef = None
+        if out.obs is not None:
+            obs_leaves, obs_tdef = jax.tree_util.tree_flatten(out.obs)
+        results = []
+        for i, sid in enumerate(sids):
+            obs_i = None
+            if obs_leaves is not None:
+                obs_i = obs_tdef.unflatten(
+                    [leaf[i] for leaf in obs_leaves]
+                )
+            res = ServeResult(sid, out, i, batched=True,
+                              params_version=ver, obs=obs_i)
+            if gens is None or (
+                self._live[sid] and self._gen[sid] == gens[i]
+            ):
+                self._apply_health(sid, res.health_mask)
+                self._record_result(res)
+            results.append(res)
+        self.stats["serve_decisions"] += len(sids)
+        self.stats["serve_batched_decisions"] += len(sids)
+        self.stats["serve_batch_calls"] += 1
+        return results
 
     def decide_batch(self, sids: list[int]) -> list[ServeResult]:
         """Up to `max_batch` sessions in ONE compiled call. A single
@@ -776,32 +1147,239 @@ class SessionStore:
             raise ValueError("duplicate session ids in one batch")
         if len(sids) == 1:
             return [self.decide(sids[0])]
+        group = self._batch_group(sids)
         batch_slots = self._ensure_hot(sids)
-        slots = np.full(self.max_batch, self.hot_capacity, np.int32)
-        slots[: len(sids)] = batch_slots
+        slots = np.full(self.max_batch, self.group_slots, np.int32)
+        slots[: len(sids)] = [
+            s % self.group_slots for s in batch_slots
+        ]
         ver = self.params_version
-        out = self._served(lambda: self._callk(jnp.asarray(slots)))
-        obs_leaves = obs_tdef = None
-        if out.obs is not None:
-            # ONE flatten per call; per-result obs are unflattened
-            # numpy views (treedef.unflatten is C++), not K tree_maps
-            obs_leaves, obs_tdef = jax.tree_util.tree_flatten(out.obs)
-        results = []
-        for i, sid in enumerate(sids):
-            obs_i = None
-            if obs_leaves is not None:
-                obs_i = obs_tdef.unflatten(
-                    [leaf[i] for leaf in obs_leaves]
+        out = self._served(
+            group, lambda: self._callk(group, jnp.asarray(slots))
+        )
+        return self._batch_results(sids, out, ver)
+
+    # -- the pipelined window (ISSUE 15) -----------------------------------
+
+    @property
+    def inflight(self) -> int:
+        """Dispatched-but-unharvested compiled calls."""
+        return len(self._inflight)
+
+    def dispatch_batch(self, sids: list[int]) -> InFlightCall:
+        """The asynchronous half of `decide_batch`: validate, page the
+        batch hot, and DISPATCH the compiled call — returning an
+        `InFlightCall` holding device output futures immediately (JAX
+        async dispatch) instead of blocking on materialization. All
+        host work (np.asarray, health, collector, tickets) happens at
+        `harvest`, in dispatch order. The same sequence of
+        dispatch_batch calls produces bit-identical decisions to the
+        same sequence of decide_batch calls (same admission order =>
+        same fold_in keys => same compiled computation); only WHEN the
+        host observes them moves."""
+        if not sids:
+            raise ValueError("empty batch")
+        if len(sids) > self.max_batch:
+            raise ValueError(
+                f"{len(sids)} sessions > max_batch={self.max_batch}"
+            )
+        for sid in sids:
+            self._check_sid(sid)
+        if len(set(sids)) != len(sids):
+            raise ValueError("duplicate session ids in one batch")
+        group = self._batch_group(sids)
+        batch_slots = self._ensure_hot(sids)
+        ver = self.params_version
+        t0 = time.perf_counter()
+        if len(sids) == 1:
+            # mirror decide_batch's lone-request fallback (the
+            # unbatched program — same program choice, same key
+            # consumption, so sync and pipelined fronts stay bit-equal
+            # under identical admission order)
+            l = batch_slots[0] % self.group_slots
+            self._stores[group], out = self._call1(
+                group, _i32(l), _i32(-1), _i32(0), jnp.bool_(False)
+            )
+            batched = False
+        else:
+            slots = np.full(self.max_batch, self.group_slots, np.int32)
+            slots[: len(sids)] = [
+                s % self.group_slots for s in batch_slots
+            ]
+            self._stores[group], out = self._callk(
+                group, jnp.asarray(slots)
+            )
+            batched = True
+        t1 = time.perf_counter()
+        self.wall_split["dispatch_s"] += t1 - t0
+        spans = {"dispatch": t0} if self.trace else None
+        call = InFlightCall(
+            sids, group, batched, out, ver,
+            [int(self._gen[s]) for s in sids], spans=spans,
+        )
+        # the deque is shared with the (optional) harvester thread:
+        # every membership change happens under the condition lock
+        with self._harvest_cv:
+            self._inflight.append(call)
+            depth = len(self._inflight)
+            self._harvest_cv.notify()
+        self.stats["serve_inflight_peak"] = max(
+            self.stats["serve_inflight_peak"], depth
+        )
+        if self.metrics is not None:
+            self.metrics.gauge("serve_inflight_depth", depth)
+        return call
+
+    def _materialize(self, call: InFlightCall):
+        """Blocking host materialization of one in-flight call's
+        outputs (np.asarray per leaf) — the harvest boundary. Uses the
+        background harvester's copy when it got there first, and
+        WAITS for a claimed-but-unfinished copy rather than running a
+        duplicate tree conversion alongside it (the claim is cleared
+        by `bg_failed`, so a poisoned call still falls through to the
+        synchronous retry here, surfacing its error)."""
+        if (call.host_out is None and call.bg_claimed
+                and not call.bg_failed):
+            with self._harvest_cv:
+                while call.host_out is None and not call.bg_failed:
+                    self._harvest_cv.wait(timeout=0.05)
+        if call.host_out is None:
+            call.host_out = jax.tree_util.tree_map(
+                np.asarray, call.out
+            )
+        return call.host_out
+
+    def pop_ready(self, wait: bool = True, limit: int | None = None
+                  ) -> list[InFlightCall]:
+        """The DEVICE half of the harvest: pop in-flight calls in
+        dispatch (FIFO) order and materialize their outputs
+        (`np.asarray` — the only blocking step). Host bookkeeping
+        (health, collector, results) is `finalize_call`'s job, so a
+        pipelined pump can sync on the oldest call, DISPATCH the next
+        one, and only then do the old call's host work — overlapped
+        with the new call's device compute. With `wait=False` only
+        calls whose device work already finished pop."""
+        done: list[InFlightCall] = []
+        while limit is None or len(done) < limit:
+            with self._harvest_cv:
+                if not self._inflight:
+                    break
+                call = self._inflight[0]
+                if not wait and not call.outputs_ready():
+                    break
+                self._inflight.popleft()
+            t0 = time.perf_counter()
+            if call.spans is not None:
+                call.spans["harvest"] = t0
+                jax.block_until_ready(call.out)
+                call.spans["device_compute"] = time.perf_counter()
+            self._materialize(call)
+            self.wall_split["blocked_host_s"] += (
+                time.perf_counter() - t0
+            )
+            if call.spans is not None:
+                call.spans["scatter_back"] = time.perf_counter()
+            if self.metrics is not None:
+                self.metrics.gauge(
+                    "serve_inflight_depth", len(self._inflight)
                 )
-            res = ServeResult(sid, out, i, batched=True,
-                              params_version=ver, obs=obs_i)
-            self._apply_health(sid, res.health_mask)
-            self._record_result(res)
-            results.append(res)
-        self.stats["serve_decisions"] += len(sids)
-        self.stats["serve_batched_decisions"] += len(sids)
-        self.stats["serve_batch_calls"] += 1
-        return results
+            done.append(call)
+        return done
+
+    def finalize_call(self, call: InFlightCall) -> list[ServeResult]:
+        """The HOST half of the harvest: build the `ServeResult`s,
+        apply health quarantines and feed the trajectory collector —
+        gated on each session's generation still matching (a session
+        closed and re-created mid-flight must not inherit the stale
+        call's health/trajectory). Idempotent; also drains the
+        pager's pending write-backs (the deferred `device_get`
+        futures, ISSUE 15)."""
+        if call.results is not None:
+            return call.results
+        out = call.host_out
+        if call.batched:
+            call.results = self._batch_results(
+                call.sids, out, call.params_version, gens=call.gens
+            )
+        else:
+            [sid] = call.sids
+            res = ServeResult(
+                sid, out, None, batched=False,
+                params_version=call.params_version, obs=out.obs,
+            )
+            if self._live[sid] and self._gen[sid] == call.gens[0]:
+                self._apply_health(sid, res.health_mask)
+                self._record_result(res)
+            self.stats["serve_decisions"] += 1
+            call.results = [res]
+        self._drain_writebacks()
+        return call.results
+
+    def harvest(self, wait: bool = True, limit: int | None = None
+                ) -> list[InFlightCall]:
+        """Drain the in-flight window in dispatch (FIFO) order: for
+        each completed call, materialize its outputs, apply health
+        quarantines, feed the trajectory collector and build the
+        `ServeResult`s (set on `call.results`) — `pop_ready` +
+        `finalize_call` in one step. With `wait=False` only calls
+        whose device work already finished are harvested — the
+        non-blocking form the pipelined front polls with; `wait=True`
+        blocks (the drain form)."""
+        done = self.pop_ready(wait=wait, limit=limit)
+        for call in done:
+            self.finalize_call(call)
+        self._drain_writebacks(wait=wait and not self._inflight)
+        return done
+
+    def _harvester_loop(self) -> None:
+        """Background harvester (daemon): materialize the OLDEST
+        in-flight call's device outputs so the serving thread's
+        `harvest` finds them host-ready. Read-only — deque membership
+        and all store mutation stay on the serving thread."""
+        while True:
+            with self._harvest_cv:
+                while not self._harvester_stop and not any(
+                    c.host_out is None and not c.bg_failed
+                    for c in self._inflight
+                ):
+                    self._harvest_cv.wait(timeout=0.05)
+                if self._harvester_stop:
+                    return
+                call = next(
+                    (c for c in self._inflight
+                     if c.host_out is None and not c.bg_failed),
+                    None,
+                )
+                if call is not None:
+                    call.bg_claimed = True
+            if call is not None:
+                try:
+                    # inline conversion, NOT _materialize: that helper
+                    # waits on claimed calls, and the claimant here is
+                    # this very thread
+                    call.host_out = jax.tree_util.tree_map(
+                        np.asarray, call.out
+                    )
+                except Exception:
+                    # a failed background materialization must never
+                    # kill serving — harvest() retries synchronously
+                    # (and surfaces the error there) — and must never
+                    # busy-spin either: mark the call so the wait
+                    # above skips it
+                    call.bg_failed = True
+                with self._harvest_cv:
+                    # wake a serving thread waiting on this claim
+                    self._harvest_cv.notify_all()
+
+    def stop_harvester(self) -> None:
+        """Stop the background harvester thread (idempotent)."""
+        if self._harvester is None:
+            return
+        with self._harvest_cv:
+            self._harvester_stop = True
+            self._harvest_cv.notify_all()
+        self._harvester.join(timeout=2.0)
+        self._harvester = None
 
     # -- observability -----------------------------------------------------
 
@@ -868,6 +1446,11 @@ def _finish_ticket(t: Ticket, store: SessionStore, metrics, runlog
         segs = (
             ("serve_span_queue_ms", "submit", "batch_admit"),
             ("serve_span_device_ms", "dispatch", "device_compute"),
+            # ISSUE 15: time the call sat dispatched-but-unharvested
+            # (the pipeline's in-flight residency) and the harvest
+            # stage's own host cost
+            ("serve_span_inflight_ms", "dispatch", "harvest"),
+            ("serve_span_harvest_ms", "harvest", "scatter_back"),
             ("serve_span_scatter_ms", "device_compute",
              "scatter_back"),
             ("serve_span_total_ms", "submit", "reply"),
@@ -1062,23 +1645,51 @@ class ContinuousBatcher:
     in the `serve_page_churn` metrics counter (each one forces a page
     round-trip when the hot set is full).
 
+    Pipelined execution (ISSUE 15, `depth` > 1): pump DISPATCHES the
+    admitted batch (`SessionStore.dispatch_batch` — device futures,
+    no host sync) and keeps up to `depth - 1` compiled calls on the
+    device (ONE at the default depth 2) plus one call in the
+    host-finalize stage; tickets resolve at HARVEST (each `poll`
+    drains every call whose device work finished). The pump NEVER
+    blocks: a full device window skips the dispatch (queued requests
+    ride a later poll — that is the backpressure), so the caller's
+    loop work overlaps device compute; and it engages ADAPTIVELY —
+    with no full next batch queued, the just-dispatched call is
+    harvested synchronously, because a deferred harvest with nothing
+    to overlap only delays replies. Admission order — and therefore
+    every compiled call and its fold_in key — is identical to the
+    `depth=1` synchronous front, so decisions are bit-equal
+    (test-pinned); only when the host observes them moves. On a
+    grouped store a batch lives in ONE slot group (`_admit_sids`
+    targets the fullest eligible group — occupancy is throughput —
+    with the `max_skips` valve letting a passed-over head retarget
+    the batch to ITS group, so the starvation bound is intact). With
+    `prefetch` (default True) and a paged store, the pager-aware
+    look-ahead also PAGES predicted-next cold sessions into free
+    slots of their group before their batch dispatches
+    (`SessionStore.prefetch` — never evicting for a prediction).
+
     Instrumentation mirrors `MicroBatcher` (shared `_finish_ticket`):
     flush reasons are `size` (a full slot dispatched at submit),
     `occupancy` (a pump dispatched a partial slot) and `forced`
     (drain); waits land in `serve_queue_wait_ms` (there is no linger
     to wait out)."""
 
-    front_name = "continuous"
-
     def __init__(self, store: SessionStore, *, metrics=None,
                  runlog=None, trace: bool = False,
-                 pager_aware: bool = True, max_skips: int = 2) -> None:
+                 pager_aware: bool = True, max_skips: int = 2,
+                 depth: int = 1, prefetch: bool = True) -> None:
         self.store = store
         self.metrics = metrics
         self.runlog = runlog
         self.trace = bool(trace)
         self.pager_aware = bool(pager_aware)
         self.max_skips = int(max_skips)
+        if depth < 1:
+            raise ValueError(f"depth={depth} must be >= 1")
+        self.depth = int(depth)
+        self.prefetch = bool(prefetch)
+        self.front_name = "pipelined" if self.depth > 1 else "continuous"
         self._queues: dict[int, deque[Ticket]] = {}
         self._rotation: deque[int] = deque()
         self._skips: dict[int, int] = {}
@@ -1091,8 +1702,19 @@ class ContinuousBatcher:
         if not q:
             self._rotation.append(sid)
         q.append(t)
-        # occupancy-driven dispatch: a full width-K slot never waits
-        if len(self._rotation) >= self.store.max_batch:
+        # occupancy-driven dispatch: a full width-K slot never waits.
+        # On a grouped store (ISSUE 15) "full" is PER GROUP — a batch
+        # lives in one group, so a rotation of K sessions spread over
+        # G groups is NOT a full slot yet (dispatching it would burn a
+        # width-K call at K/G fill; the next poll serves partials)
+        st = self.store
+        if st.groups == 1:
+            if len(self._rotation) >= st.max_batch:
+                self.pump(reason="size")
+        elif len(self._rotation) >= st.max_batch and sum(
+            1 for s in self._rotation
+            if st.session_group(s) == st.session_group(sid)
+        ) >= st.max_batch:
             self.pump(reason="size")
         return t
 
@@ -1104,16 +1726,53 @@ class ContinuousBatcher:
         """Serve one batch if anything is queued; True when one ran.
         The drivers' poll loop IS the continuous-batching engine: each
         call re-fills the serving slot with whatever arrived while the
-        previous compiled call was in flight."""
+        previous compiled call was in flight. Under pipelining the
+        poll additionally HARVESTS every in-flight call whose device
+        work finished (resolving its tickets) — the host-work stage
+        that overlaps the next call's device compute."""
+        # pump drains completed in-flight calls on every exit path
+        # (and reports a harvest-only pass as True), so one call does
+        # the whole poll — no second readiness scan per loop
         return self.pump(reason="occupancy")
 
     def flush(self) -> None:
-        """Drain the whole queue (end-of-schedule / shutdown)."""
+        """Drain the whole queue (end-of-schedule / shutdown): every
+        queued request is dispatched and every in-flight call is
+        harvested — no ticket left unresolved. Blocking is fine HERE
+        (there is no more overlap work to protect): when the window is
+        full the flush waits out the oldest call instead of spinning."""
         while self._rotation:
-            self.pump(reason="forced")
+            if not self.pump(reason="forced") and self.store.inflight:
+                self._harvest(wait=True, limit=1)
+        self._harvest(wait=True)
 
     def _finish(self, t: Ticket) -> None:
         _finish_ticket(t, self.store, self.metrics, self.runlog)
+
+    def _resolve(self, calls: list) -> int:
+        """Finalize popped in-flight calls (dispatch order) and
+        resolve their tickets; returns the number of calls resolved.
+        The shared `_finish_ticket` contract is unchanged — the
+        store-level spans of EACH harvested call are staged into
+        `store.last_spans` before its tickets finish."""
+        for call in calls:
+            results = self.store.finalize_call(call)
+            self.store.last_spans = (
+                call.spans if self.store.trace else None
+            )
+            tickets = call.tickets or []
+            for t, r in zip(tickets, results):
+                t.result = r
+                self._finish(t)
+            self._evict_unservable(tickets)
+        return len(calls)
+
+    def _harvest(self, wait: bool, limit: int | None = None) -> int:
+        if self.depth <= 1:
+            return 0
+        return self._resolve(
+            self.store.pop_ready(wait=wait, limit=limit)
+        )
 
     def _evict_unservable(self, batch: list[Ticket]) -> None:
         """Mid-stream eviction: any batch member whose decision
@@ -1153,11 +1812,21 @@ class ContinuousBatcher:
         sessions, then cold ones — all in rotation order within each
         class. Sessions passed over are charged one skip and KEEP
         their rotation position, so the preference can only delay a
-        head by `max_skips` pumps."""
+        head by `max_skips` pumps. On a GROUPED store (ISSUE 15) a
+        batch additionally lives in ONE slot group: the target is the
+        (starvation-forced, else rotation-head) session's group, and
+        other-group window sessions are passed over exactly like cold
+        ones — they keep their position, so the next pump's head
+        selects THEIR group, and the skip valve still force-admits
+        (by retargeting the batch's group) after `max_skips`."""
         K = min(self.store.max_batch, len(self._rotation))
         st = self.store
-        if (not self.pager_aware or st.hot_capacity >= st.capacity
-                or len(self._rotation) <= K):
+        grouped = st.groups > 1
+        paged = st.hot_capacity < st.capacity
+        if not grouped and (
+            not self.pager_aware or not paged
+            or len(self._rotation) <= K
+        ):
             out = [self._rotation.popleft() for _ in range(K)]
             for s in out:
                 # an admission by ANY path resets the starvation
@@ -1170,21 +1839,58 @@ class ContinuousBatcher:
             s for s in window
             if self._skips.get(s, 0) >= self.max_skips
         ]
+        if grouped:
+            if forced:
+                # the starvation valve picks the batch's group: the
+                # oldest-starved session admits NOW
+                tg = st.session_group(forced[0])
+            else:
+                # fullest-group admission: target the group with the
+                # most eligible window sessions (occupancy is
+                # throughput — a width-K call costs the same at any
+                # fill), tie-broken toward the rotation head's group
+                # so equal-backlog groups alternate fairly. A head
+                # passed over is skip-charged below and force-admits
+                # (retargeting the batch to ITS group) within
+                # max_skips pumps — the bound stays structural.
+                counts: dict[int, int] = {}
+                for s in window:
+                    g = st.session_group(s)
+                    counts[g] = counts.get(g, 0) + 1
+                head_g = st.session_group(window[0])
+                tg = max(
+                    counts,
+                    key=lambda g: (counts[g], g == head_g, -g),
+                )
+            eligible = [
+                s for s in window if st.session_group(s) == tg
+            ]
+            forced = [s for s in forced if s in set(eligible)]
+        else:
+            eligible = window
         taken = set(forced[:K])
         picked = forced[:K]
-        for prefer_hot in (True, False):
-            for s in window:
+        prefer = (
+            (True, False) if self.pager_aware and paged else (None,)
+        )
+        for prefer_hot in prefer:
+            for s in eligible:
                 if len(picked) >= K:
                     break
-                if s in taken or st.is_hot(s) is not prefer_hot:
+                if s in taken or (
+                    prefer_hot is not None
+                    and st.is_hot(s) is not prefer_hot
+                ):
                     continue
                 picked.append(s)
                 taken.add(s)
-        n_cold = sum(1 for s in picked if not st.is_hot(s))
-        if self.metrics is not None and n_cold:
-            # each cold admission is one page round-trip once the hot
-            # set is full — the churn the preference exists to cut
-            self.metrics.counter("serve_page_churn", n_cold)
+        if self.metrics is not None and paged:
+            n_cold = sum(1 for s in picked if not st.is_hot(s))
+            if n_cold:
+                # each cold admission is one page round-trip once the
+                # hot set is full — the churn the preference exists
+                # to cut
+                self.metrics.counter("serve_page_churn", n_cold)
         for s in window:
             if s not in taken:
                 self._skips[s] = self._skips.get(s, 0) + 1
@@ -1195,12 +1901,47 @@ class ContinuousBatcher:
         )
         return picked
 
+    def _prefetch_ahead(self) -> None:
+        """The look-ahead's prefetch half (ISSUE 15): while the batch
+        just dispatched computes, page predicted-next COLD sessions of
+        the 2K rotation window into free slots of their groups
+        (`SessionStore.prefetch` — never evicting for a prediction),
+        so their batch dispatches without a page-in on its critical
+        path. Pipelined fronts only: the synchronous front's pump
+        would pay the put before its own batch's harvest anyway."""
+        st = self.store
+        if not (self.prefetch and self.depth > 1
+                and st.hot_capacity < st.capacity):
+            return
+        for sid in list(self._rotation)[: 2 * st.max_batch]:
+            if not st.is_hot(sid):
+                st.prefetch(sid)
+
     def pump(self, reason: str = "occupancy") -> bool:
         """Admit up to `max_batch` queue heads (round-robin over the
-        session rotation, hot-preferring under a paged store) and
-        serve them in ONE compiled call; True when a batch ran."""
+        session rotation, hot-preferring under a paged store,
+        one-group-per-batch on a grouped store) and serve them in ONE
+        compiled call — synchronously at `depth=1`, as a dispatched
+        in-flight call under pipelining (tickets resolve at harvest);
+        True when a batch ran."""
+        ripe: list = []
+        if self.depth > 1:
+            # the pipelined pump NEVER blocks: the caller's loop work
+            # (arrival submission, ticket scans, learner pumps) is
+            # exactly the host work the pipeline overlaps with device
+            # compute, and one blocking sync here would serialize it
+            # all behind the in-flight call. Drain whatever finished,
+            # and if the device window (depth-1 calls; ONE at the
+            # default depth 2 — on shared CPU silicon a second
+            # concurrent call only stretches both, raise depth on a
+            # real chip) is still full, DON'T dispatch into it:
+            # queued requests ride a later poll, which is the
+            # backpressure.
+            ripe = self.store.pop_ready(wait=False)
+            if self.store.inflight > max(self.depth - 2, 0):
+                return self._resolve(ripe) > 0
         if not self._rotation:
-            return False
+            return self._resolve(ripe) > 0
         m = self.metrics
         if m is not None:
             m.counter(f"serve_flush_{reason}")
@@ -1226,16 +1967,55 @@ class ContinuousBatcher:
                 t.trace.stamp("batch_admit", now)
         if m is not None:
             m.observe("serve_batch_occupancy", len(batch))
+        sids = [t.session_id for t in batch]
+        if self.depth > 1:
+            # the pipelined path: dispatch, then do the RIPE call's
+            # host work while this batch computes
+            try:
+                if self.trace:
+                    with annotate("serve/dispatch"):
+                        call = self.store.dispatch_batch(sids)
+                else:
+                    call = self.store.dispatch_batch(sids)
+            except Exception:
+                # a bad session id fails at validation, before any
+                # dispatch; re-serve one by one so only the offender
+                # fails its ticket (the synchronous fallback). Resolve
+                # the ripe calls and DRAIN the window FIRST: a
+                # fallback decide() may serve a session whose OLDER
+                # decision is still in flight, and the collector must
+                # see a session's decisions in order (this is the
+                # cold error path — blocking here is fine)
+                self._resolve(ripe)
+                self._harvest(wait=True)
+                for t in batch:
+                    try:
+                        t.result = self.store.decide(t.session_id)
+                    except Exception as e:
+                        t.error = e
+                    self._finish(t)
+                self._evict_unservable(batch)
+                return True
+            call.tickets = batch
+            self._prefetch_ahead()
+            self._resolve(ripe)
+            if len(self._rotation) < self.store.max_batch:
+                # ADAPTIVE depth: no full next batch is queued behind
+                # this call, so a deferred harvest has little overlap
+                # work to hide and would only delay THIS call's
+                # replies by a poll round — harvest synchronously
+                # (bit-identical results either way; only the reply
+                # time moves). The pipeline engages exactly in the
+                # backlogged regime, where overlap buys call rate and
+                # call rate is goodput.
+                self._harvest(wait=True)
+            return True
         try:
             if self.trace:
                 with annotate("serve/flush"):
-                    results = self.store.decide_batch(
-                        [t.session_id for t in batch]
-                    )
+                    results = self.store.decide_batch(sids)
             else:
-                results = self.store.decide_batch(
-                    [t.session_id for t in batch]
-                )
+                results = self.store.decide_batch(sids)
         except Exception:
             # a bad session id poisons the whole batch call; re-serve
             # one by one so only the offender fails its ticket
@@ -1287,6 +2067,10 @@ def store_from_config(
         # ISSUE 14: compile the record-on serve programs (per-decision
         # StoredObs records — the online trajectory path's payload)
         "record": bool(cfg.get("record", False)),
+        # ISSUE 15: independently-donated slot groups (the in-flight
+        # window's width) + the optional background harvester thread
+        "groups": int(cfg.get("groups", 1)),
+        "harvester": bool(cfg.get("harvester", False)),
     }
     # ISSUE 13: the pager (device slots < sessions) and the dp-sharded
     # store; both default off so an r11 block builds an r11 store
@@ -1310,15 +2094,45 @@ def front_from_config(
     **overrides: Any,
 ) -> "ContinuousBatcher | MicroBatcher":
     """Build the batching front the `serve:` block names:
-    `front: continuous` (the ISSUE-13 default) or `front: linger`
-    (the r10/r11 fixed-linger `MicroBatcher`, kept for A/B runs —
-    `linger_ms` applies to it alone). Unknown fronts fail loudly."""
+    `front: continuous` (the ISSUE-13 default), `front: pipelined`
+    (ISSUE 15 — the continuous batcher with a depth-D in-flight
+    window over the store's slot groups; `depth` defaults to the
+    store's group count, `prefetch` gates the look-ahead pager), or
+    `front: linger` (the r10/r11 fixed-linger `MicroBatcher`, kept
+    for A/B runs — `linger_ms` applies to it alone). Unknown fronts
+    fail loudly."""
     cfg = dict(cfg or {})
     front = str(cfg.get("front", "continuous"))
-    if front == "continuous":
+    if front != "pipelined":
+        # fail loudly (the serve-config contract): pipeline knobs on
+        # a synchronous front would be silently dropped — the
+        # operator believes they enabled a depth-D window while every
+        # row stamps a synchronous front
+        stray = {"depth", "prefetch"} & set(cfg)
+        if stray:
+            raise ValueError(
+                f"serve: {sorted(stray)} only apply to "
+                f"front: pipelined (got front: {front})"
+            )
+    if front in ("continuous", "pipelined"):
         overrides.setdefault(
             "pager_aware", bool(cfg.get("pager_aware", True))
         )
+        if front == "pipelined":
+            depth = int(cfg.get("depth", max(2, store.groups)))
+            if depth < 2:
+                # fail loudly (the serve-config contract): a depth-1
+                # "pipelined" front would silently BE the continuous
+                # front while every row/summary stamps the wrong label
+                raise ValueError(
+                    f"front: pipelined needs depth >= 2, got {depth} "
+                    "(depth 1 is the synchronous continuous front — "
+                    "name it that)"
+                )
+            overrides.setdefault("depth", depth)
+            overrides.setdefault(
+                "prefetch", bool(cfg.get("prefetch", True))
+            )
         return ContinuousBatcher(store, **overrides)
     if front == "linger":
         return MicroBatcher(
@@ -1326,5 +2140,6 @@ def front_from_config(
             **overrides,
         )
     raise ValueError(
-        f"unknown serve front {front!r}; known: continuous, linger"
+        f"unknown serve front {front!r}; known: continuous, "
+        "pipelined, linger"
     )

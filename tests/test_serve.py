@@ -759,13 +759,320 @@ def test_sharded_store_decision_parity(setup, plain6):
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 15: pipelined serve execution — slot groups, dispatch/harvest,
+# decision bit-parity vs the synchronous front, zero-recompile +
+# param-swap under depth >= 2 / groups >= 2, the starvation bound
+# under max_skips exhaustion, prefetch, and the harvester thread
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gstore(setup):
+    """A 2-group store (capacity 6, 3 slots per group, unpaged) — the
+    pipelined tests' shared subject. One AOT lowering at the [3] group
+    shape serves both groups."""
+    params, bank, sched = setup
+    return SessionStore(
+        params, bank, sched, capacity=6, groups=2, max_batch=3, seed=0
+    )
+
+
+def test_grouped_store_dispatch_harvest_parity(gstore, plain6):
+    """The tentpole's parity pin (store level): the SAME sequence of
+    batches dispatched through the pipelined window (two groups in
+    flight at once, harvest deferred) is decision-for-decision
+    BIT-IDENTICAL — rewards, dt, wall clock, log-probs included — to
+    the synchronous `decide_batch` path at the same seeds and
+    admission order. Pipelining moves WHEN the host materializes,
+    never what the device computes. Cross-group batches are rejected
+    loudly (a batch is ONE compiled call over ONE group buffer)."""
+    pipe, sync = gstore, plain6
+    sync._calls = pipe._calls
+    ps = [pipe.create(seed=900 + i) for i in range(6)]
+    ss = [sync.create(seed=900 + i) for i in range(6)]
+    g0 = [s for s in ps if pipe.session_group(s) == 0]
+    g1 = [s for s in ps if pipe.session_group(s) == 1]
+    assert len(g0) == len(g1) == 3  # balanced static assignment
+    s0 = [ss[ps.index(s)] for s in g0]
+    s1 = [ss[ps.index(s)] for s in g1]
+    with pytest.raises(ValueError, match="spans slot groups"):
+        pipe.decide_batch([g0[0], g1[0]])
+    for rnd in range(3):
+        # pipelined arm: both groups dispatched before ANY harvest —
+        # the in-flight window is genuinely 2 deep
+        c0 = pipe.dispatch_batch(g0)
+        c1 = pipe.dispatch_batch(g1)
+        assert pipe.inflight == 2
+        r0 = sync.decide_batch(s0)
+        r1 = sync.decide_batch(s1)
+        done = pipe.harvest(wait=True)
+        assert [len(c.results) for c in done] == [3, 3]
+        assert (c0.results, c1.results) == (
+            done[0].results, done[1].results
+        )
+        for rs, rp in zip(r0 + r1, c0.results + c1.results):
+            ds, dp = rs.to_dict(), rp.to_dict()
+            ds.pop("session_id"), dp.pop("session_id")
+            assert ds == dp, (rnd, ds, dp)
+    assert pipe.inflight == 0
+    assert pipe.stats["serve_inflight_peak"] >= 2
+    # the wall split saw both components move (satellite: the
+    # dispatch-vs-blocked split bench_serve_latency reports)
+    assert pipe.wall_split["dispatch_s"] > 0.0
+    assert pipe.wall_split["blocked_host_s"] > 0.0
+    for s in ps:
+        pipe.close(s)
+    for s in ss:
+        sync.close(s)
+
+
+def test_pipelined_front_parity_vs_synchronous_front(setup):
+    """The acceptance pin (front level): the pipelined
+    `ContinuousBatcher` (depth 2 over a 2-group store) resolves every
+    ticket with results BIT-EQUAL to the synchronous continuous front
+    (depth 1) on an identically-configured store under the identical
+    submission order — same admission sequence => same compiled calls
+    => same fold_in keys => identical rewards."""
+    params, bank, sched = setup
+    arms = {}
+    for depth in (1, 2):
+        st = SessionStore(
+            params, bank, sched, capacity=6, groups=2, max_batch=3,
+            seed=0,
+        )
+        front = ContinuousBatcher(st, depth=depth)
+        assert front.front_name == (
+            "pipelined" if depth > 1 else "continuous"
+        )
+        sids = [st.create(seed=950 + i) for i in range(6)]
+        tickets = [front.submit(s) for _ in range(3) for s in sids]
+        while front.pending or st.inflight:
+            front.flush()
+        assert all(t.ready and t.error is None for t in tickets)
+        arms[depth] = [t.result.to_dict() for t in tickets]
+        for s in sids:
+            st.close(s)
+    assert arms[1] == arms[2]
+
+
+def test_pipelined_warm_path_and_param_swap_zero_recompiles(
+    gstore, tmp_path
+):
+    """Acceptance: the zero-recompile guarantees hold under
+    pipelining (depth >= 2, groups >= 2). With the runlog jit hooks
+    at threshold 0, a warm window of dispatch/harvest cycles across
+    BOTH groups — including a hot param swap mid-window — writes no
+    jit_compile records; the in-flight call dispatched BEFORE the
+    swap keeps its dispatch-time version while the next call carries
+    the new one (one params value per compiled call — no torn
+    reads)."""
+    import json
+
+    from sparksched_tpu.obs import runlog as runlog_mod
+
+    store = gstore
+    sids = [store.create(seed=970 + i) for i in range(6)]
+    g0 = [s for s in sids if store.session_group(s) == 0]
+    g1 = [s for s in sids if store.session_group(s) == 1]
+    # warm glue (fold_in, slot padding) AND the swap payload outside
+    # the pinned window
+    store.harvest(wait=True)
+    store.dispatch_batch(g0)
+    store.dispatch_batch(g1)
+    store.harvest(wait=True)
+    new_params = jax.device_get(jax.tree_util.tree_map(
+        lambda x: x * 1.01, store.model_params
+    ))
+
+    monkey_prev = runlog_mod.JIT_MIN_SECS
+    runlog_mod.JIT_MIN_SECS = 0.0
+    rl = runlog_mod.RunLog(str(tmp_path / "pipe.jsonl"))
+    rl.install_jit_hooks()
+    try:
+        v0 = store.params_version
+        c_pre = store.dispatch_batch(g0)  # in flight across the swap
+        v1 = store.set_params(new_params)
+        c_post = store.dispatch_batch(g1)
+        done = store.harvest(wait=True)
+        assert len(done) == 2
+        assert {r.params_version for r in c_pre.results} == {v0}
+        assert {r.params_version for r in c_post.results} == {v1}
+        for _ in range(3):
+            store.dispatch_batch(g0)
+            store.dispatch_batch(g1)
+            store.harvest(wait=True)
+    finally:
+        runlog_mod.JIT_MIN_SECS = monkey_prev
+        rl.close()
+        store.rollback_params(reason="test")
+        for s in sids:
+            store.close(s)
+    recs = [json.loads(ln) for ln in open(rl.path)]
+    compiles = [r for r in recs if r["ev"].startswith("jit_compile")]
+    assert compiles == [], compiles
+
+
+def test_continuous_batcher_starvation_bound_under_skip_exhaustion(
+    setup
+):
+    """The fairness test gap (ISSUE 15 satellite): adversarial
+    hot/cold interleaving on a paged store where `max_skips` exhausts
+    repeatedly — 6 backlogged sessions over 4 device slots, width-2
+    batches, so the hot-preferring admission passes cold sessions
+    over until the valve forces them. The structural bound must hold
+    for EVERY request: a session's queue head is admitted within
+    ceil(S/K) + max_skips pumps of becoming head, and
+    `serve_page_churn` counts exactly the forced (cold) admissions —
+    each one a page round-trip, since the hot set stays full."""
+    import math
+
+    from sparksched_tpu.obs.metrics import MetricsRegistry
+
+    params, bank, sched = setup
+    store = SessionStore(
+        params, bank, sched, capacity=12, hot_capacity=4, max_batch=2,
+        seed=0,
+    )
+    S, R = 6, 6  # backlogged sessions x requests each
+    max_skips = 2
+    bound = math.ceil(S / store.max_batch) + max_skips
+    sids = [store.create(seed=1200 + i) for i in range(S)]
+    reg = MetricsRegistry()
+    front = ContinuousBatcher(
+        store, pager_aware=True, max_skips=max_skips, metrics=reg
+    )
+    # seed the full backlog with auto-pump suppressed, so every pump
+    # sees the whole rotation — the regime where the hot preference
+    # has a choice and cold sessions CAN starve without the valve
+    real_k = store.max_batch
+    store.max_batch = 10 ** 6
+    tickets = {s: [front.submit(s) for _ in range(R)] for s in sids}
+    store.max_batch = real_k
+    ins0 = store.stats["serve_page_ins"]
+
+    resolved_at: dict[int, list[int]] = {s: [] for s in sids}
+    pumps = 0
+    while front.pending or store.inflight:
+        assert front.pump(reason="occupancy"), "queue stuck"
+        pumps += 1
+        assert pumps < S * R + 10, "no forward progress"
+        for s in sids:
+            n_ready = sum(1 for t in tickets[s] if t.ready)
+            while len(resolved_at[s]) < n_ready:
+                resolved_at[s].append(pumps)
+    for s in sids:
+        assert all(
+            t.ready and t.error is None for t in tickets[s]
+        ), s
+        # per-request head-wait: request k becomes its session's
+        # queue head when request k-1 resolves (pump 0 for the first)
+        prev = 0
+        for p in resolved_at[s]:
+            assert p - prev <= bound, (
+                f"session {s}: head waited {p - prev} pumps "
+                f"> ceil(S/K)+max_skips = {bound}"
+            )
+            prev = p
+    # the churn counter counts the forced page-ins: the hot set stayed
+    # full, so every cold admission paid a page round-trip
+    churn = int(reg.counters.get("serve_page_churn", 0))
+    assert churn > 0
+    assert store.stats["serve_page_ins"] - ins0 == churn
+    for s in sids:
+        store.close(s)
+
+
+def test_pipelined_prefetch_pages_ahead_into_free_slots(setup):
+    """The look-ahead prefetch (ISSUE 15): on a paged grouped store
+    under a pipelined front, predicted-next cold sessions are paged
+    into FREE slots of their group while the current batch computes —
+    counted by `serve_prefetches` — and every request still resolves
+    with its session's own state (prefetch is placement, never
+    semantics). A prediction never evicts: with no free slot the
+    prefetch is refused."""
+    params, bank, sched = setup
+    store = SessionStore(
+        params, bank, sched, capacity=8, hot_capacity=4, groups=2,
+        max_batch=2, seed=0,
+    )
+    sids = [store.create(seed=1300 + i) for i in range(8)]
+    # a full hot set refuses predictions (free slots only, no
+    # eviction for a guess), and a hot session is a no-op
+    cold_full = next(s for s in sids if not store.is_hot(s))
+    assert not store.has_free_slot(store.session_group(cold_full))
+    assert store.prefetch(cold_full) is False
+    assert store.prefetch(next(
+        s for s in sids if store.is_hot(s)
+    )) is False
+    # open one free slot per group (the rotation/close traffic real
+    # serving produces), leaving cold sessions queued behind hot ones
+    for g in (0, 1):
+        victim = next(
+            s for s in sids
+            if store.is_hot(s) and store.session_group(s) == g
+        )
+        store.close(victim)
+        sids.remove(victim)
+    front = ContinuousBatcher(store, depth=2, prefetch=True)
+    real_k = store.max_batch
+    store.max_batch = 10 ** 6
+    tickets = [front.submit(s) for _ in range(3) for s in sids]
+    store.max_batch = real_k
+    while front.pending or store.inflight:
+        front.flush()
+    assert all(t.ready and t.error is None for t in tickets)
+    assert store.stats["serve_prefetches"] > 0
+    for s in sids:
+        store.close(s)
+
+
+def test_background_harvester_materializes_inflight(gstore):
+    """The `harvester` flag's thread: it materializes the oldest
+    in-flight call's outputs off the serving thread (host_out set
+    without the caller blocking), `harvest()` consumes the copy, and
+    results are the same ServeResults the foreground path builds.
+    `stop_harvester` is idempotent."""
+    import threading
+    import time as _time
+
+    store = gstore
+    assert store._harvester is None
+    store._harvester_stop = False
+    store._harvester = threading.Thread(
+        target=store._harvester_loop, daemon=True,
+        name="serve-harvester-test",
+    )
+    store._harvester.start()
+    try:
+        sids = [store.create(seed=1400 + i) for i in range(3)]
+        gsids = [
+            s for s in sids
+            if store.session_group(s) == store.session_group(sids[0])
+        ]
+        call = store.dispatch_batch(gsids)
+        deadline = _time.monotonic() + 10.0
+        while call.host_out is None and _time.monotonic() < deadline:
+            _time.sleep(0.005)
+        assert call.host_out is not None, "harvester never picked up"
+        [done] = store.harvest(wait=True)
+        assert done is call and len(done.results) == len(gsids)
+        assert all(r.decided for r in done.results)
+    finally:
+        store.stop_harvester()
+        store.stop_harvester()  # idempotent
+        for s in sids:
+            store.close(s)
+    assert store._harvester is None
+
+
+# ---------------------------------------------------------------------------
 # serve: config block + bench row schema helpers
 # ---------------------------------------------------------------------------
 
 
-def test_store_from_config_rejects_unknown_keys(setup):
+def test_store_from_config_rejects_unknown_keys(setup, store):
     from sparksched_tpu.config import SERVE_KEYS
-    from sparksched_tpu.serve import store_from_config
+    from sparksched_tpu.serve import front_from_config, store_from_config
 
     params, bank, sched = setup
     with pytest.raises(ValueError, match="unknown serve"):
@@ -775,6 +1082,19 @@ def test_store_from_config_rejects_unknown_keys(setup):
     # the ISSUE-11 instrumentation keys are part of the declared
     # surface (config.SERVE_KEYS is the single source of truth)
     assert {"trace", "metrics"} <= SERVE_KEYS
+    # ISSUE 15: the pipelining knobs are declared, and the pipelined
+    # front resolves to a depth>1 ContinuousBatcher (depth defaults
+    # to the store's group count, floor 2)
+    assert {"groups", "depth", "harvester", "prefetch"} <= SERVE_KEYS
+    front = front_from_config({"front": "pipelined"}, store)
+    assert isinstance(front, ContinuousBatcher)
+    assert front.front_name == "pipelined" and front.depth >= 2
+    with pytest.raises(ValueError, match="unknown serve front"):
+        front_from_config({"front": "warp"}, store)
+    # a depth-1 "pipelined" front IS the continuous front and would
+    # mislabel every row — rejected loudly, not silently degraded
+    with pytest.raises(ValueError, match="depth >= 2"):
+        front_from_config({"front": "pipelined", "depth": 1}, store)
 
 
 def test_latency_row_blocks():

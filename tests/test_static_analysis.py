@@ -57,6 +57,11 @@ def test_shipped_tree_is_analysis_clean():
         # capped while the record-off programs above pin that record
         # off changes nothing
         "serve_decide_record", "serve_decide_batch_record",
+        # ISSUE 15: the group-shaped store program (the pipelined
+        # store's [hot_capacity/groups] lowering) — pinned
+        # count-identical to serve_decide_batch: slot groups are
+        # host-side call routing, never traced structure
+        "serve_decide_batch_group",
     }
     assert set(report["passes"]["jaxpr"]["measured"]) == all_programs
     mem = report["passes"]["memory"]["measured"]
@@ -331,6 +336,53 @@ def test_rule_time_in_jit_fires(tmp_path):
     })
     got = [v for v in vs if v.rule == "time-in-jit"]
     assert len(got) == 3 and all("env/bad" in v.where for v in got), vs
+
+
+def test_rule_serve_host_sync_fires(tmp_path):
+    """ISSUE 15: blocking syncs (`jax.device_get` /
+    `block_until_ready` / eager `np.asarray`) in the serve pump hot
+    path (serve/session.py) fire OUTSIDE the harvest/trace boundary,
+    stay silent inside it (`_served`, `harvest`, `_materialize`,
+    `_drain_writebacks` — the sanctioned functions), honor the
+    line-level pragma escape, and do not apply to other serve files
+    (loadgen is host-side by contract)."""
+    vs = _lint_tree(tmp_path, {
+        "serve/session.py": """\
+            import jax
+            import numpy as np
+
+            def pump(store, out):
+                jax.block_until_ready(out)       # violation
+                a = np.asarray(out)              # violation
+                b = jax.device_get(out)          # violation
+                c = jax.device_get(out)  # analysis: allow(serve-host-sync)
+                return a, b, c
+
+            def harvest(out):
+                return np.asarray(out)           # sanctioned
+
+            def _served(call):
+                import jax
+                jax.block_until_ready(call)      # sanctioned
+                return jax.device_get(call)      # sanctioned
+
+            def _drain_writebacks(entry):
+                return np.asarray(entry)         # sanctioned
+        """,
+        # other serve files are NOT in the pump scope
+        "serve/loadgen.py": """\
+            import jax
+
+            def run(x):
+                return jax.device_get(x)
+        """,
+    })
+    got = [v for v in vs if v.rule == "serve-host-sync"]
+    assert len(got) == 3 and all(
+        "serve/session.py" in v.where for v in got
+    ), vs
+    # the generic host-sync rule stays exempt for these HOST_FILES
+    assert [v for v in vs if v.rule == "host-sync"] == []
 
 
 def test_rule_bare_print_fires(tmp_path):
