@@ -832,13 +832,105 @@ def stage_serve_pipe():
             "SERVE_SCALE_AB_REPS", "3"
         ),
         # the on-chip window is for the front A/B; the online arm has
-        # its own CPU artifact and would double the window
+        # its own CPU artifact and would double the window — and the
+        # network tier has its own stage (18)
         "SERVE_SCALE_ONLINE": os.environ.get("SERVE_SCALE_ONLINE", "0"),
+        "SERVE_SCALE_NET": os.environ.get("SERVE_SCALE_NET", "0"),
     }
     r = subprocess.run(
         [sys.executable, "-c", code], cwd=repo, timeout=3600, env=env,
     )
     print(f"[serve-pipe] subprocess rc={r.returncode}", flush=True)
+
+
+def stage_serve_net():
+    """ISSUE 16: the network serving tier on a chip host — the
+    loopback HTTP A/B (the SAME chip-backed store served direct vs
+    through the wire at the same seeded schedule, so the delta is the
+    HTTP front) plus the replica-fleet sweep behind the
+    session-affinity router (`bench_decima.bench_serve_scale`'s
+    SERVE_SCALE_NET arm), written as paired `serve_scale_net` rows +
+    artifacts/serve_net_chip.json. The FLEET replicas run on host
+    cores by default (SERVE_SCALE_FLEET_PLATFORM=cpu, the bench's
+    chip-host default): one device client per chip means N spawned
+    processes cannot all claim the parent's accelerator — override
+    with per-process device slices to put replicas on their own chips.
+    Runs ENTIRELY in a subprocess, gate included; a chipless host
+    prints an explicit `[serve-net] UNAVAILABLE` marker and exits 0 —
+    the watcher log must distinguish "no window" from "never ran". The
+    CPU-host measurement lives in artifacts/serve_scale_r18.json /
+    PERF.md round 18."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    if _client_held():
+        print("[serve-net] parent process already holds a device "
+              "client; run stage 18 as its own invocation", flush=True)
+        return
+    repo = osp.dirname(osp.abspath(__file__))
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from sparksched_tpu.config import (\n"
+        "    enable_compilation_cache, honor_jax_platforms_env,\n"
+        "    use_fast_prng,\n"
+        ")\n"
+        "honor_jax_platforms_env()\n"
+        "enable_compilation_cache()\n"
+        "if os.environ.get('BENCH_PRNG', 'rbg') == 'rbg':\n"
+        "    use_fast_prng()\n"
+        "import jax\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    print('[serve-net] UNAVAILABLE: cpu backend only; the "
+        "chip-scale network-tier rows need a chip window (the CPU "
+        "measurement is recorded in artifacts/serve_scale_r18.json "
+        "and PERF.md round 18)', flush=True)\n"
+        "    sys.exit(0)\n"
+        "import bench_decima\n"
+        "bench_decima.bench_serve_scale(\n"
+        "    artifact='artifacts/serve_net_chip.json')\n"
+    )
+    env = os.environ | {
+        # one mid-curve direct reference point (the full sweep is
+        # stage 15/17's job); the window here is the wire A/B + fleet
+        "SERVE_SCALE_FRONTS": os.environ.get(
+            "SERVE_SCALE_FRONTS", "continuous"
+        ),
+        "SERVE_SCALE_OFFERED": os.environ.get(
+            "SERVE_SCALE_OFFERED", "500"
+        ),
+        "SERVE_SCALE_MMPP": os.environ.get("SERVE_SCALE_MMPP", "0"),
+        "SERVE_SCALE_CAPACITY": os.environ.get(
+            "SERVE_SCALE_CAPACITY", "64"
+        ),
+        "SERVE_SCALE_BATCH": os.environ.get("SERVE_SCALE_BATCH", "16"),
+        "SERVE_SCALE_TENANTS": os.environ.get(
+            "SERVE_SCALE_TENANTS", "32"
+        ),
+        "SERVE_SCALE_REQUESTS": os.environ.get(
+            "SERVE_SCALE_REQUESTS", "1000"
+        ),
+        "SERVE_SCALE_SLO_MS": os.environ.get(
+            "SERVE_SCALE_SLO_MS", "25"
+        ),
+        "SERVE_SCALE_AB_REPS": os.environ.get(
+            "SERVE_SCALE_AB_REPS", "3"
+        ),
+        "SERVE_SCALE_NET": os.environ.get("SERVE_SCALE_NET", "1"),
+        "SERVE_SCALE_NET_RPS": os.environ.get(
+            "SERVE_SCALE_NET_RPS", "500"
+        ),
+        "SERVE_SCALE_REPLICAS": os.environ.get(
+            "SERVE_SCALE_REPLICAS", "1,2,4"
+        ),
+        "SERVE_SCALE_ONLINE": os.environ.get("SERVE_SCALE_ONLINE", "0"),
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, timeout=3600, env=env,
+    )
+    print(f"[serve-net] subprocess rc={r.returncode}", flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -919,6 +1011,7 @@ STAGES = {
     "15": ("serve-scale open-loop capture", stage_serve_scale),
     "16": ("continuous-batching A/B capture", stage_serve_cb),
     "17": ("pipelined-serve A/B capture", stage_serve_pipe),
+    "18": ("network serving tier capture", stage_serve_net),
 }
 
 
@@ -952,11 +1045,11 @@ if __name__ == "__main__":
                 print("chip unavailable; aborting session", flush=True)
                 break
         finally:
-            # 7, 12, 13, 14, 15, 16 and 17 run in subprocesses and 10
-            # is CPU-subprocess-only: none takes the in-process device
-            # client
+            # 7, 12, 13, 14, 15, 16, 17 and 18 run in subprocesses
+            # and 10 is CPU-subprocess-only: none takes the in-process
+            # device client
             if p not in ("7", "10", "12", "13", "14", "15", "16",
-                         "17"):
+                         "17", "18"):
                 _mark_client_held()
             if ledger_path:
                 ledger[p] = {

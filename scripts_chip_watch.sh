@@ -39,6 +39,8 @@ rm -f /tmp/serve_scale_done
 rm -f /tmp/serve_cb_done
 # ... and for the pipelined-serve A/B capture (stage 17, ISSUE 15)
 rm -f /tmp/serve_pipe_done
+# ... and for the network serving tier capture (stage 18, ISSUE 16)
+rm -f /tmp/serve_net_done
 # stage-completion ledger (ISSUE 9): per-LIFETIME like the markers
 # above — a restarted watcher must re-run its multi-stage sessions, not
 # inherit a previous lifetime's completions (the ledger's job is
@@ -309,6 +311,24 @@ print('ALIVE')
       echo "serve-pipe rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
       grep -q '"backend": "tpu"' /tmp/serve_pipe_last.log \
         && touch "$SERVE_PIPE_MARK"
+    fi
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time network-serving-tier capture (ISSUE 16, stage 18): the
+    # loopback HTTP A/B with the store on the chip + the replica-fleet
+    # sweep behind the session-affinity router (fleet replicas on host
+    # cores — one device client per chip; see the stage docstring) —
+    # the on-chip partner of the CPU measurement in
+    # artifacts/serve_scale_r18.json / PERF.md round 18, queued behind
+    # the 13-17 slots. Once per watcher lifetime; marked done only
+    # when a TPU-backed row landed (an UNAVAILABLE marker means no
+    # window yet — retry next loop, like the earlier slots).
+    SERVE_NET_MARK=/tmp/serve_net_done
+    if [ ! -f "$SERVE_NET_MARK" ]; then
+      timeout -k 60 3700 python scripts_chip_session.py 18 \
+        | tee /tmp/serve_net_last.log
+      echo "serve-net rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q '"backend": "tpu"' /tmp/serve_net_last.log \
+        && touch "$SERVE_NET_MARK"
     fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable

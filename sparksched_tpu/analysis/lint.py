@@ -83,6 +83,13 @@ HOST_FILES = frozenset({
     # registry-audited serve/ppo programs, not these files
     "online/__init__.py", "online/trajectory.py",
     "online/learner.py", "online/bus.py",
+    # ISSUE 16: the network tier's request/response boundary — the
+    # HTTP front and the replica router are host bookkeeping end to
+    # end (sockets, pipes, wall-clock timeouts ARE the product);
+    # their traced code is the same registry-audited serve programs,
+    # built per-replica through store_from_config. Jaxpr-exempt but
+    # still AST-linted (bare-print etc. apply).
+    "serve/server.py", "serve/router.py",
 })
 
 # host-side entry points inside otherwise-hot modules, PATH-QUALIFIED

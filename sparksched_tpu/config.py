@@ -160,6 +160,16 @@ SERVE_KEYS = frozenset({
     "depth",  # `front: pipelined` in-flight window depth (default: groups)
     "harvester",  # background harvester thread for output materialization
     "prefetch",  # pipelined front: page predicted-next sessions ahead
+    # ISSUE 16: the network serving tier (serve/server.py HTTP front +
+    # serve/router.py replica fleet) — consumed by `server_from_config`,
+    # ignored by `store_from_config` exactly like the `front:` knobs.
+    # All default OFF: no `replicas`/`port` keys => the in-process
+    # store, byte-identical to the r15 path (zero-cost-off).
+    "host",  # HTTP front bind address (default 127.0.0.1)
+    "port",  # HTTP front port (0 = OS-assigned ephemeral, reported back)
+    "replicas",  # serve-fleet width (0/absent = in-process, no fleet)
+    "quota_sessions",  # per-tenant live-session quota (0 = unlimited)
+    "quota_inflight",  # per-tenant outstanding-decide quota (0 = unlimited)
 })
 
 ONLINE_KEYS = frozenset({

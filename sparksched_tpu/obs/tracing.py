@@ -80,11 +80,25 @@ class annotate:
 # MicroBatcher additionally enters `annotate("serve/flush")` around
 # the dispatch, so a Perfetto capture carries the same phase label the
 # trace records use.
+#
+# Across the wire (ISSUE 16): the network client brackets the walk
+# with `wire_submit` (the instant the request leaves the client) and
+# `wire_reply` (the instant the decoded reply is in the client's
+# hands). The server's spans ride back in the reply as offsets and
+# are re-anchored so the server-side `submit` coincides with the
+# client's `wire_submit` — by construction, `reply -> wire_reply`
+# is then the request's total NETWORK + serialization overhead (both
+# directions plus server-side parse), while `dispatch ->
+# device_compute` stays the device share and the harvest spans the
+# host share. One clock never spans two machines: each side stamps
+# only its own perf_counter, and only OFFSETS cross the wire. The
+# runlog `trace` record shape is unchanged — the wire spans are just
+# two more keys in `spans_ms`.
 # ---------------------------------------------------------------------------
 
 SPAN_ORDER = (
-    "submit", "batch_admit", "dispatch", "harvest", "device_compute",
-    "scatter_back", "reply",
+    "wire_submit", "submit", "batch_admit", "dispatch", "harvest",
+    "device_compute", "scatter_back", "reply", "wire_reply",
 )
 
 _TRACE_SEQ = itertools.count()
@@ -111,6 +125,10 @@ class RequestTrace:
 
     def offsets_ms(self) -> dict[str, float]:
         base = self.spans.get("submit")
+        if base is None:
+            # a wire-side trace that never reached a server (429 /
+            # transport error) still has its client bracket
+            base = self.spans.get("wire_submit")
         if base is None:
             return {}
         return {

@@ -19,6 +19,16 @@ one probation window, not the service. A version that survives its
 window is marked proven and becomes the next rollback target. Publish
 is latest-wins: if the learner outpaces serving, intermediate versions
 are skipped (counted), never queued.
+
+Across the process boundary (ISSUE 16): `store` is duck-typed — a
+`serve.router.Router` exposes the same `set_params`/`rollback_params`/
+`stats` facade, so one bus publishes a version to EVERY replica of a
+serve fleet (the router broadcasts the host-materialized pytree over
+its pipes; each replica applies it between compiled calls — zero
+recompiles on every member, the params-as-runtime-argument contract),
+and probation reads the router's aggregated decision/quarantine
+counters instead of one store's. Nothing here changes for the fleet
+case; that is the point.
 """
 
 from __future__ import annotations

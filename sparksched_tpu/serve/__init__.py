@@ -8,6 +8,11 @@ programs), `serve/session.py` (the session API), `serve/loadgen.py`
 (seeded open-loop Poisson/MMPP load generation — ISSUE 11), and the
 README "Serving" / "Serving at load" sections for the warmup protocol
 and knobs.
+
+The network tier (ISSUE 16) rides on top: `serve/server.py` (the HTTP
+front + `ServeClient` wire client) and `serve/router.py` (the
+session-affinity multi-process replica fleet) — both lazy-imported
+here so the in-process path never pays for them (zero-cost-off).
 """
 
 from .aot import (
@@ -22,6 +27,7 @@ from .session import (
     ContinuousBatcher,
     InFlightCall,
     MicroBatcher,
+    RemoteResult,
     ServeResult,
     SessionError,
     SessionQuarantined,
@@ -42,6 +48,7 @@ __all__ = [
     "ContinuousBatcher",
     "InFlightCall",
     "MicroBatcher",
+    "RemoteResult",
     "ServeResult",
     "SessionError",
     "SessionQuarantined",
@@ -49,4 +56,32 @@ __all__ = [
     "Ticket",
     "front_from_config",
     "store_from_config",
+    # ISSUE 16 network tier (import from serve.server / serve.router;
+    # named here for discoverability, lazily resolved via __getattr__)
+    "ServeServer",
+    "ServeClient",
+    "server_from_config",
+    "Router",
+    "ReplicaSpec",
+    "ReplicaDied",
 ]
+
+_NET_EXPORTS = {
+    "ServeServer": "server",
+    "ServeClient": "server",
+    "server_from_config": "server",
+    "Router": "router",
+    "ReplicaSpec": "router",
+    "ReplicaDied": "router",
+}
+
+
+def __getattr__(name: str):
+    mod = _NET_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

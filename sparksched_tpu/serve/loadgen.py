@@ -179,10 +179,36 @@ def run_open_loop(
     per driver iteration, BETWEEN compiled serve calls — the hook the
     online loop hangs `ParamBus.pump` on, so hot param swaps land
     mid-run under live traffic without the driver knowing about
-    them."""
+    them.
+
+    Client mode (ISSUE 16): `store` and `batcher` are duck-typed, so
+    passing a `serve.server.ServeClient` as BOTH drives a remote
+    server over the wire with the SAME loop — latency still clocked
+    from SCHEDULED arrival, so network + queueing time counts against
+    the server exactly like host time does in-process. The summary's
+    `reconcile` block pins the rejection accounting either way:
+    requests == served + rejected, with the per-request
+    `serve_requests_rejected` counter delta equal to the summary's
+    rejection count and distinct from the store's per-create
+    `serve_capacity_rejections`."""
     n = len(arrivals)
     if n == 0:
         raise ValueError("empty arrival schedule")
+    if getattr(batcher, "front_name", "") == "http":
+        # push-based wire front: poll() is a no-op and replies are
+        # resolved by the client's worker threads, so a hot 0.2 ms
+        # poll loop would only steal (possibly the single) core from
+        # them — in-process fronts keep the tight loop because their
+        # poll() IS the batching engine
+        poll_sleep_s = max(poll_sleep_s, 2e-3)
+    # reconciliation baselines (ISSUE 16): the registry may be shared
+    # across runs, so the double-count check below is on DELTAS
+    metrics = getattr(store, "metrics", None)
+    rej0 = (0 if metrics is None
+            else metrics.counters.get("serve_requests_rejected", 0))
+    stats = getattr(store, "stats", None)
+    cap0 = (stats.get("serve_capacity_rejections", 0)
+            if isinstance(stats, dict) else None)
     tenants = sorted({w for _, w in arrivals})
     sessions: dict[int, int | None] = {
         w: store.create(seed=session_seed + w) for w in tenants
@@ -274,6 +300,38 @@ def run_open_loop(
             if sid is not None:
                 store.close(sid)
     makespan = time.perf_counter() - t0
+    # the ISSUE-16 reconciliation pin for the PR-11 double-count
+    # hazard flagged above: every scheduled request is EITHER served
+    # (`completed`, which `errors`/`good` partition) or turned away
+    # (`rejections`) — never both, never neither — and the per-request
+    # `serve_requests_rejected` counter moves in lockstep with the
+    # summary while staying DISTINCT from the store's per-create
+    # `serve_capacity_rejections` (whose unit is failed create()
+    # calls: rotation attempts, not turned-away traffic).
+    assert completed + rejections == n, (
+        f"open-loop accounting broke: {completed} served + "
+        f"{rejections} rejected != {n} scheduled"
+    )
+    reconcile: dict[str, Any] = {
+        "requests": n,
+        "served": completed,
+        "rejected_requests": rejections,
+        "distinct_counters": True,
+    }
+    if metrics is not None:
+        rej_delta = (
+            metrics.counters.get("serve_requests_rejected", 0) - rej0
+        )
+        assert rej_delta == rejections, (
+            f"serve_requests_rejected moved by {rej_delta} but the "
+            f"run rejected {rejections} request(s) — the per-request "
+            "and per-create rejection counters have been conflated"
+        )
+        reconcile["serve_requests_rejected"] = rej_delta
+    if cap0 is not None:
+        reconcile["serve_capacity_rejections"] = (
+            stats.get("serve_capacity_rejections", 0) - cap0
+        )
     out: dict[str, Any] = {
         "requests": n,
         "front": getattr(batcher, "front_name", "unknown"),
@@ -288,6 +346,7 @@ def run_open_loop(
         "goodput_rps": round(good / makespan, 2),
         "session_rotations": rotations,
         "capacity_rejections": rejections,
+        "reconcile": reconcile,
         "hist": hist,
     }
     if samples is not None:

@@ -221,6 +221,48 @@ class ServeResult:
         }
 
 
+class RemoteResult:
+    """`ServeResult`'s wire twin (ISSUE 16): a decision decoded from a
+    `ServeResult.to_dict()` payload that crossed a process or socket
+    boundary. Field-compatible with everything the host consumers read
+    (`done`/`health_mask` for session rotation, `params_version` as
+    the staleness stamp), plus the two wire-only fields: `replica`
+    (which fleet member served it, -1 in-process) and `spans_ms` (the
+    server-side Dapper offsets riding the reply). `obs` is always
+    None — record-mode payloads do not cross the wire (the online
+    trajectory path runs inside the replica that owns the store)."""
+
+    __slots__ = (
+        "session_id", "stage_idx", "job_idx", "num_exec", "lgprob",
+        "decided", "done", "reward", "dt", "wall_time", "health_mask",
+        "batched", "params_version", "obs", "replica", "spans_ms",
+    )
+
+    def __init__(self, d: dict[str, Any]) -> None:
+        self.session_id = int(d["session_id"])
+        self.stage_idx = int(d.get("stage_idx", -1))
+        self.job_idx = int(d.get("job_idx", -1))
+        self.num_exec = int(d.get("num_exec", 0))
+        self.lgprob = float(d.get("lgprob", 0.0))
+        self.decided = bool(d.get("decided", False))
+        self.done = bool(d.get("done", False))
+        self.reward = float(d.get("reward", 0.0))
+        self.dt = float(d.get("dt", 0.0))
+        self.wall_time = float(d.get("wall_time", 0.0))
+        self.health_mask = int(d.get("health_mask", 0))
+        self.batched = bool(d.get("batched", False))
+        self.params_version = int(d.get("params_version", 0))
+        self.obs = None
+        self.replica = int(d.get("replica", -1))
+        self.spans_ms = d.get("spans_ms")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            k: getattr(self, k) for k in self.__slots__
+            if k not in ("obs", "spans_ms")
+        }
+
+
 class InFlightCall:
     """One dispatched-but-unharvested compiled serve call (ISSUE 15).
 
