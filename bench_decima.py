@@ -64,6 +64,82 @@ from sparksched_tpu.obs.memory import memory_row_stamp  # noqa: E402
 
 MEMFIT = os.environ.get("BENCH_MEMFIT", "1") == "1"
 
+# ISSUE 17 satellite: resume the headline bench series. Every row any
+# bench in this file emits is also collected here, and main() writes
+# the lot as a top-level `BENCH_rNN.json` summary (round from
+# BENCH_ROUND, default 19 — the series stalled at BENCH_r05.json).
+# The perf ledger (sparksched_tpu/obs/ledger.py) indexes that file as
+# the round's anchor. BENCH_SUMMARY=0 skips the write (sub-benches
+# invoked standalone by other harnesses should not stamp a round).
+_SUMMARY_ROWS: list[dict] = []
+
+
+def _emit_row(row: dict) -> None:
+    _SUMMARY_ROWS.append(row)
+    print(json.dumps(row), flush=True)
+    # rewrite the summary artifact after EVERY row: a bench run killed
+    # mid-series (box timeout, ^C) still leaves a valid round artifact
+    # holding exactly the rows it measured
+    _write_bench_summary(quiet=True)
+
+
+def _write_bench_summary(quiet: bool = False) -> None:
+    if os.environ.get("BENCH_SUMMARY", "1") != "1":
+        return
+    rnd = int(os.environ.get("BENCH_ROUND", "19"))
+    # carried headline anchors: the standing in-process serving
+    # headlines, restated at this round so the series carries them
+    # forward explicitly. `carried: true` + `source` mark them as
+    # re-anchored prior measurements, not fresh runs of this round.
+    anchors: list[dict] = []
+
+    def _carry(metric: str, value, unit: str, source: str) -> None:
+        if value is not None:
+            anchors.append({
+                "metric": metric, "value": value, "unit": unit,
+                "carried": True, "source": source,
+            })
+
+    try:
+        with open("artifacts/serve_scale_r17.json") as fp:
+            slo = json.load(fp)["protocol"]["sustained_rps_slo"]
+        _carry("sustained_rps_slo_continuous", slo.get("continuous"),
+               "rps", "artifacts/serve_scale_r17.json")
+    except (OSError, KeyError, ValueError):
+        pass
+    try:
+        with open("artifacts/serve_scale_r18.json") as fp:
+            rows = json.load(fp)["rows"]
+        loop = [r for r in rows
+                if r.get("metric") == "serve_scale_net50rps_loopback"]
+        if loop:
+            _carry("serve_scale_net50rps_loopback",
+                   loop[-1].get("value"), loop[-1].get("unit", ""),
+                   "artifacts/serve_scale_r18.json")
+    except (OSError, KeyError, ValueError):
+        pass
+    out = {
+        "n": rnd,
+        "round": rnd,
+        "schema": "bench_summary_v1",
+        "cmd": "python bench_decima.py",
+        "rows": _SUMMARY_ROWS,
+        "anchors": anchors,
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(("DEC_BENCH_", "SERVE_BENCH",
+                                 "SERVE_SCALE_BENCH", "BENCH_",
+                                 "JAX_PLATFORMS"))},
+    }
+    path = f"BENCH_r{rnd:02d}.json"
+    # atomic replace: a run killed mid-write must never leave a
+    # truncated artifact for the ledger's coverage gate to trip on
+    with open(path + ".tmp", "w") as fp:
+        json.dump(out, fp, indent=1)
+    os.replace(path + ".tmp", path)
+    if not quiet:
+        print(f"# wrote {path}: {len(_SUMMARY_ROWS)} rows + "
+              f"{len(anchors)} carried anchors", flush=True)
+
 
 def _registry_proxy_stamp() -> dict:
     """Memory stamp for rows without a per-lane collection program:
@@ -354,7 +430,7 @@ def bench_inference(
     }
     if TELEMETRY:
         row["telemetry"] = summarize(telem, prev=telem_snap)
-    print(json.dumps(row), flush=True)
+    _emit_row(row)
 
 
 def _latency_block(samples_ms: list[float], reps: int) -> dict:
@@ -544,7 +620,7 @@ def bench_serve_latency(
         }
         rows.append(row)
         runlog.latency(lat, batch=cfg_extra.get("batch"), metric=metric)
-        print(json.dumps(row), flush=True)
+        _emit_row(row)
 
     # --- batch=1: the unbatched donated AOT path (a dedicated
     # session, so an episode ending mid-window never touches the
@@ -1056,7 +1132,7 @@ def bench_serve_scale(
             }
             rows.append(row)
             runlog.metrics(snap, metric=row["metric"])
-            print(json.dumps(row), flush=True)
+            _emit_row(row)
 
     # ---- the online arm (ISSUE 14): the closed serve->learn->serve
     # loop at one offered-load point — goodput@SLO + reward trend
@@ -1244,7 +1320,7 @@ def bench_serve_scale(
         }
         rows.append(row)
         runlog.metrics(reg.snapshot(), metric=row["metric"])
-        print(json.dumps(row), flush=True)
+        _emit_row(row)
         online_protocol = {
             "loop": "record-on store + ContinuousBatcher serving the "
                     "seeded schedule; background OnlineLearner "
@@ -1424,7 +1500,7 @@ def bench_serve_scale(
                 },
             )
             rows.append(row)
-            print(json.dumps(row), flush=True)
+            _emit_row(row)
 
         # (b) the replica sweep: client -> HTTP front -> affinity
         # router -> N spawned replica processes, each owning its own
@@ -1497,7 +1573,7 @@ def bench_serve_scale(
                 },
             )
             rows.append(row)
-            print(json.dumps(row), flush=True)
+            _emit_row(row)
 
         cores = os.cpu_count() or 1
         net_protocol = {
@@ -1731,7 +1807,7 @@ def bench_ppo(
     }
     if summaries:
         row["telemetry"] = summaries[-1]
-    print(json.dumps(row), flush=True)
+    _emit_row(row)
 
 
 if __name__ == "__main__":
@@ -1754,36 +1830,47 @@ if __name__ == "__main__":
     infer_steps = int(os.environ.get("DEC_BENCH_INFER_STEPS", 512))
     ppo_envs = int(os.environ.get("DEC_BENCH_PPO_ENVS", 1024))
     ppo_steps = int(os.environ.get("DEC_BENCH_PPO_STEPS", 256))
-    bench_inference(num_envs=infer_envs, steps=infer_steps)
-    bench_inference(
-        num_envs=infer_envs, steps=infer_steps, compute_dtype="bfloat16"
-    )
-    bench_inference(num_envs=infer_envs, steps=infer_steps, engine="flat")
-    bench_inference(
-        num_envs=infer_envs, steps=infer_steps, compute_dtype="bfloat16",
-        engine="flat",
-    )
-    bench_inference(
-        num_envs=infer_envs, steps=infer_steps, engine="fastpath"
-    )
-    bench_inference(
-        num_envs=infer_envs, steps=infer_steps, compute_dtype="bfloat16",
-        engine="fastpath",
-    )
-    # ISSUE 7 dtype sweep: the f32 fastpath row above vs the quantized
-    # (int16 dur table, per-template scale) bank on the SAME collector
-    # and knobs — the low-precision layout's throughput effect as a
-    # recorded A/B. DEC_BENCH_BANK_DTYPE overrides the swept layout.
-    bench_inference(
-        num_envs=infer_envs, steps=infer_steps, engine="fastpath",
-        bank_dtype=os.environ.get("DEC_BENCH_BANK_DTYPE", "int16"),
-    )
-    bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps)
-    bench_ppo(
-        num_envs=ppo_envs, rollout_steps=ppo_steps,
-        compute_dtype="bfloat16",
-    )
-    bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps, engine="flat")
+    # DEC_BENCH_INFER=0 / DEC_BENCH_PPO=0 skip whole sections (the
+    # SERVE_BENCH idiom) so a time-boxed round can run just the slice
+    # it is re-measuring
+    if os.environ.get("DEC_BENCH_INFER", "1") == "1":
+        bench_inference(num_envs=infer_envs, steps=infer_steps)
+        bench_inference(
+            num_envs=infer_envs, steps=infer_steps,
+            compute_dtype="bfloat16",
+        )
+        bench_inference(
+            num_envs=infer_envs, steps=infer_steps, engine="flat"
+        )
+        bench_inference(
+            num_envs=infer_envs, steps=infer_steps,
+            compute_dtype="bfloat16", engine="flat",
+        )
+        bench_inference(
+            num_envs=infer_envs, steps=infer_steps, engine="fastpath"
+        )
+        bench_inference(
+            num_envs=infer_envs, steps=infer_steps,
+            compute_dtype="bfloat16", engine="fastpath",
+        )
+        # ISSUE 7 dtype sweep: the f32 fastpath row above vs the
+        # quantized (int16 dur table, per-template scale) bank on the
+        # SAME collector and knobs — the low-precision layout's
+        # throughput effect as a recorded A/B. DEC_BENCH_BANK_DTYPE
+        # overrides the swept layout.
+        bench_inference(
+            num_envs=infer_envs, steps=infer_steps, engine="fastpath",
+            bank_dtype=os.environ.get("DEC_BENCH_BANK_DTYPE", "int16"),
+        )
+    if os.environ.get("DEC_BENCH_PPO", "1") == "1":
+        bench_ppo(num_envs=ppo_envs, rollout_steps=ppo_steps)
+        bench_ppo(
+            num_envs=ppo_envs, rollout_steps=ppo_steps,
+            compute_dtype="bfloat16",
+        )
+        bench_ppo(
+            num_envs=ppo_envs, rollout_steps=ppo_steps, engine="flat"
+        )
     # ISSUE 10: decision-serving latency rows (p50/p99, batch=1 vs
     # batch=K, cold start + linger sweep) through the AOT session
     # store; SERVE_BENCH=0 skips (the rows also run standalone from
@@ -1796,3 +1883,6 @@ if __name__ == "__main__":
     # chip-session stage 15 at chip scale)
     if os.environ.get("SERVE_SCALE_BENCH", "1") == "1":
         bench_serve_scale()
+    # ISSUE 17: the round's top-level summary artifact (the headline
+    # bench series the perf ledger indexes)
+    _write_bench_summary()

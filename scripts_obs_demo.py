@@ -21,7 +21,12 @@ writes `artifacts/runlog/obs_demo.jsonl`:
    flush windows through a tiny AOT session store with the metrics
    registry + per-request span tracing + runlog `trace` records on vs
    the bare round-13 front, same interleaved-median protocol, same
-   <5% bar (OBS_DEMO_SERVE=0 skips the store compile).
+   <5% bar (OBS_DEMO_SERVE=0 skips the store compile);
+6. A/B-times the FLEET plane (ISSUE 17): the same instrumented flush
+   windows with a `FleetCollector` + burn-rate `SLOMonitor` scraping
+   on EVERY window (`period_s=0` — the worst case; production scrapes
+   once per second) vs no collector, isolating the collector/SLO cost
+   from the serve instrumentation cost measured in 5, same bar.
 
 The task-duration sampler is pinned to a deterministic table lookup for
 the parity section (the two engines draw from legitimately different
@@ -266,7 +271,7 @@ def overhead_section(log: RunLog) -> float:
     return max(pct, mem_pct)
 
 
-def serve_overhead_section(log: RunLog) -> float:
+def serve_overhead_section(log: RunLog) -> tuple[float, object]:
     """ISSUE 11: the serving-path instrumentation A/B — ONE harness,
     shared with the `serve_scale` artifact's recorded number
     (`bench_decima._serve_obs_overhead`: uninstrumented vs fully
@@ -293,7 +298,98 @@ def serve_overhead_section(log: RunLog) -> float:
          f"({'PASS' if ab['passed'] else 'FAIL'}, bar: <5%)")
     log.write("serve_overhead", off_ms=ab["off_ms"], on_ms=ab["on_ms"],
               overhead_pct=pct, passed=ab["passed"])
-    return pct
+    return pct, store
+
+
+def fleet_overhead_section(log: RunLog, store) -> float:
+    """ISSUE 17: the fleet-plane A/B. Both arms run the SAME fully
+    instrumented flush windows (metrics registry on the store, so the
+    serve instrumentation cost — already measured above — cancels);
+    the `on` arm additionally scrapes a `FleetCollector` with a
+    burn-rate `SLOMonitor` after EVERY window (`period_s=0`). That is
+    the worst case by construction: the production server pump scrapes
+    once per `collect_period_s` (default 1 s), i.e. once per ~100
+    windows at the width-8 store's throughput, so a <5% per-window
+    verdict here bounds the deployed cost at ~0.05%. Reuses the warm
+    AOT store from the serve section (no second compile)."""
+    import os
+    import tempfile
+
+    from sparksched_tpu.obs.fleet import FleetCollector, render_status
+    from sparksched_tpu.obs.metrics import (
+        MetricsRegistry,
+        interleaved_ab,
+    )
+    from sparksched_tpu.obs.slo import SLOMonitor, SLOSpec
+    from sparksched_tpu.serve import MicroBatcher
+
+    def same_group_sessions(base: int) -> list[int]:
+        cand = [store.create(seed=base + i)
+                for i in range(2 * store.max_batch)]
+        g0 = store.session_group(cand[0])
+        keep = [s for s in cand
+                if store.session_group(s) == g0][: store.max_batch]
+        for s in cand:
+            if s not in keep:
+                store.close(s)
+        return keep
+
+    sids = same_group_sessions(7000)
+    store.metrics, store.trace = MetricsRegistry(), False
+    mb = MicroBatcher(store, linger_ms=1e6, metrics=store.metrics)
+    fleet_log = RunLog(os.path.join(
+        tempfile.mkdtemp(prefix="fleet_ab_"), "fleet.jsonl"))
+    # generous bounds: healthy traffic must produce ZERO alerts — the
+    # arm measures scrape + burn-rate evaluation, not alert emission
+    collector = FleetCollector(
+        store, period_s=0.0, runlog=fleet_log,
+        slo=SLOMonitor(
+            [SLOSpec("p99_ms", "latency", 1e4, budget=0.01),
+             SLOSpec("quarantine_rate", "ratio", 0.5, budget=0.02)],
+            runlog=fleet_log,
+        ),
+    )
+
+    def window(scrape: bool) -> float:
+        t0 = time.perf_counter()
+        tks = [mb.submit(s) for s in sids]  # full batch => auto-flush
+        if scrape:
+            collector.maybe_scrape()
+        dt = time.perf_counter() - t0
+        results = [t.result for t in tks if t.result is not None]
+        if any(r.done or r.health_mask for r in results):
+            for s in sids:
+                store.close(s)
+            sids[:] = same_group_sessions(7500)
+        return dt
+
+    def arm_off() -> float:
+        return window(scrape=False)
+
+    def arm_on() -> float:
+        return window(scrape=True)
+
+    t_off, t_on, pct = interleaved_ab(
+        arm_off, arm_on, warmups=2, reps=5
+    )
+    status = collector.fleet_status()
+    emit("fleet scoreboard (pseudo-replica view of the demo store):")
+    emit(render_status(status))
+    n_alerts = collector.stats["collector_alerts"]
+    emit(f"fleet plane per-window ({store.max_batch}-wide windows, "
+         f"scrape+SLO every window): off {t_off*1e3:.2f} ms, on "
+         f"{t_on*1e3:.2f} ms -> overhead {pct:+.2f}% "
+         f"({'PASS' if pct < 5.0 else 'FAIL'}, bar: <5%); "
+         f"alerts on healthy traffic: {n_alerts} (must be 0)")
+    log.write("fleet_overhead", off_ms=round(t_off * 1e3, 4),
+              on_ms=round(t_on * 1e3, 4), overhead_pct=round(pct, 2),
+              scrapes=collector.stats["collector_scrapes"],
+              alerts=n_alerts, passed=pct < 5.0 and n_alerts == 0)
+    fleet_log.close()
+    for s in sids:
+        store.close(s)
+    store.metrics = None
+    return pct if n_alerts == 0 else 100.0
 
 
 def main() -> int:
@@ -310,7 +406,8 @@ def main() -> int:
     ok = parity_section(log)
     pct = overhead_section(log)
     if os.environ.get("OBS_DEMO_SERVE", "1") == "1":
-        pct = max(pct, serve_overhead_section(log))
+        serve_pct, store = serve_overhead_section(log)
+        pct = max(pct, serve_pct, fleet_overhead_section(log, store))
     log.close(parity_ok=ok, overhead_pct=round(pct, 2))
     emit(f"runlog written: {log.path}")
     return 0 if ok and pct < 5.0 else 1

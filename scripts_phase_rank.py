@@ -18,6 +18,15 @@ the measured batch-max while tax of `drain_to_decision` /
 `_resume_simulation`) and the bulk-pass consumption ratio (events
 consumed by bulk passes per bulk iteration — the dispatch-fusion win
 `bulk_fused` exists to raise).
+
+`--runlog PATH` (ISSUE 17 satellite) additionally appends one
+`phase_rank` record per input row to that JSONL run log — the same
+ranked split as data (phase/iters/share rows + drain/bulk stats), so
+chip-session phase splits land in the stream the perf ledger and the
+fleet CLI read instead of living only in pasted markdown:
+
+  python bench.py | python scripts_phase_rank.py \\
+      --runlog artifacts/runlog/phase_rank.jsonl
 """
 
 from __future__ import annotations
@@ -26,10 +35,35 @@ import json
 import sys
 
 
+def _walk(obj):
+    """Yield telemetry-stamped row dicts from one parsed JSON value:
+    a bare row, a summary with a top-level `rows` list (BENCH_r*),
+    or an artifact nesting row lists one level down (MULTICHIP_r*)."""
+    if not isinstance(obj, dict):
+        return
+    if "telemetry" in obj:
+        yield obj
+        return
+    nests = [obj] + [v for v in obj.values() if isinstance(v, dict)]
+    for d in nests:
+        for r in d.get("rows") or []:
+            if isinstance(r, dict) and "telemetry" in r:
+                yield r
+
+
 def _rows(paths: list[str]):
     streams = [open(p) for p in paths] if paths else [sys.stdin]
     for fp in streams:
-        for line in fp:
+        text = fp.read()
+        # saved artifacts are one (usually indented, multi-line) JSON
+        # document; bench stdout captures are JSON lines. Try the
+        # document parse first, fall back to line mode.
+        try:
+            yield from _walk(json.loads(text))
+            continue
+        except json.JSONDecodeError:
+            pass
+        for line in text.splitlines():
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
@@ -37,15 +71,7 @@ def _rows(paths: list[str]):
                 obj = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if isinstance(obj, dict) and "telemetry" in obj:
-                yield obj
-            elif isinstance(obj, dict):
-                # saved artifact files nest rows (e.g. MULTICHIP_r*)
-                for v in obj.values():
-                    if isinstance(v, dict) and "rows" in v:
-                        for r in v["rows"]:
-                            if isinstance(r, dict) and "telemetry" in r:
-                                yield r
+            yield from _walk(obj)
 
 
 def phase_table(row: dict) -> str:
@@ -97,11 +123,57 @@ def phase_table(row: dict) -> str:
     return "\n".join(out)
 
 
+def phase_rank_record(row: dict) -> dict:
+    """The `phase_rank` runlog payload: `phase_table`'s ranked split
+    as data (one dict per phase, shares summing to ~1) plus the
+    drain/bulk stats, keyed by the source row's metric."""
+    tm = row.get("telemetry", {})
+    dec = max(int(tm.get("decisions", 0)), 1)
+    phases = tm.get("phase_iters") or {}
+    total = sum(phases.values()) or 1
+    ranked = [
+        {"rank": i, "phase": name, "iters": int(n),
+         "iters_per_decision": round(n / dec, 4),
+         "share": round(n / total, 4)}
+        for i, (name, n) in enumerate(
+            sorted(phases.items(), key=lambda kv: -kv[1]), 1)
+    ]
+    return {
+        "metric": row.get("metric"), "value": row.get("value"),
+        "unit": row.get("unit"),
+        "backend": row.get("config", {}).get("backend"),
+        "phases": ranked,
+        "drain_iters_mean": tm.get("drain_iters_mean"),
+        "drain_iters_max": tm.get("drain_iters_max"),
+        "drain_straggler_ratio": tm.get("drain_straggler_ratio"),
+        "straggler_ratio": tm.get("straggler_ratio"),
+    }
+
+
 def main(argv: list[str]) -> int:
+    runlog_path = None
+    if "--runlog" in argv:
+        i = argv.index("--runlog")
+        try:
+            runlog_path = argv[i + 1]
+        except IndexError:
+            print("--runlog needs a path", file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+    runlog = None
+    if runlog_path is not None:
+        from sparksched_tpu.obs.runlog import RunLog
+
+        runlog = RunLog(runlog_path)
     n = 0
     for row in _rows(argv):
         print(phase_table(row))
+        if runlog is not None:
+            runlog.phase_rank([phase_rank_record(row)],
+                              source=row.get("metric"))
         n += 1
+    if runlog is not None:
+        runlog.close()
     if n == 0:
         print(
             "# phase_rank: no telemetry-stamped rows found (pipe "

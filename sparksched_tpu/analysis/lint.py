@@ -90,6 +90,12 @@ HOST_FILES = frozenset({
     # built per-replica through store_from_config. Jaxpr-exempt but
     # still AST-linted (bare-print etc. apply).
     "serve/server.py", "serve/router.py",
+    # ISSUE 17: the fleet observability plane — scrape loops, burn-
+    # rate window arithmetic, and artifact-JSON indexing are host
+    # bookkeeping by definition (wall clocks and files ARE the
+    # product); nothing in them traces. Already under the obs/
+    # sync-exempt dir; named here so the host scoping is explicit.
+    "obs/fleet.py", "obs/slo.py", "obs/ledger.py",
 })
 
 # host-side entry points inside otherwise-hot modules, PATH-QUALIFIED

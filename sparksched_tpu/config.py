@@ -170,6 +170,16 @@ SERVE_KEYS = frozenset({
     "replicas",  # serve-fleet width (0/absent = in-process, no fleet)
     "quota_sessions",  # per-tenant live-session quota (0 = unlimited)
     "quota_inflight",  # per-tenant outstanding-decide quota (0 = unlimited)
+    # ISSUE 17: the fleet observability plane (obs/fleet.py collector +
+    # obs/slo.py burn-rate monitor) — consumed by `server_from_config`,
+    # stripped before the store like the other network-layer keys.
+    # Default OFF: no `collect` key => no collector, no scrape loop,
+    # `/fleet` 404s (zero-cost-off).
+    "collect",  # attach the fleet collector (scrapes ride the pump)
+    "collect_period_s",  # scrape period (default 1.0 s)
+    "slo",  # nested declarative SLO block (obs.slo.SLO_CONFIG_KEYS:
+    #   p99_ms, goodput_floor_rps, quarantine_rate_max, max_staleness,
+    #   windows, rollback_on, cooldown_s, min_events)
 })
 
 ONLINE_KEYS = frozenset({
@@ -205,6 +215,8 @@ OBS_KEYS = frozenset({
     "trace_iteration",  # capture a labeled device trace of iteration N
     "trace_dir",  # where that trace lands
     "runlog_max_bytes",  # size-cap + numbered-suffix runlog rotation
+    "slo",  # declarative SLO block for non-serving loops (same nested
+    #   surface as serve: slo — obs.slo.SLO_CONFIG_KEYS)
 })
 
 CHAOS_KEYS = frozenset({

@@ -32,8 +32,19 @@ Three parts (ISSUE 2 tentpole), each usable on its own:
   per-request `RequestTrace` span clock the serving front stamps
   (submit -> batch_admit -> dispatch -> device_compute ->
   scatter_back -> reply, the runlog `trace` record kind).
+- `fleet` / `slo` / `ledger`: the fleet observability plane
+  (ISSUE 17) — per-replica labeled scrape collector + windowed
+  scoreboard (`FleetCollector`, the `/fleet` endpoint and
+  `python -m sparksched_tpu.obs.fleet` CLI), declarative SLOs under
+  multi-window burn-rate alerting with optional ParamBus rollback
+  (`SLOMonitor`, the `alert` record kind) plus the online-loop depth
+  probe (`OnlineLoopProbe`), and the cross-round perf-regression
+  ledger over `artifacts/*.json` + `BENCH_*.json`
+  (`python -m sparksched_tpu.obs.ledger`, the tier-1 gate).
 """
 
+from .fleet import FleetCollector, labeled_prometheus  # noqa: F401
+from .ledger import Ledger  # noqa: F401
 from .memory import device_memory_stats, lane_fit  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
@@ -42,5 +53,11 @@ from .metrics import (  # noqa: F401
     percentile_block,
 )
 from .runlog import RunLog, emit  # noqa: F401
+from .slo import (  # noqa: F401
+    OnlineLoopProbe,
+    SLOMonitor,
+    SLOSpec,
+    slo_from_config,
+)
 from .telemetry import Telemetry, summarize, telemetry_zeros  # noqa: F401
 from .tracing import RequestTrace, annotate  # noqa: F401

@@ -125,6 +125,51 @@ class StreamingHistogram:
         self.max = max(self.max, other.max)
         return self
 
+    def copy(self) -> "StreamingHistogram":
+        """Independent snapshot with the same geometry and counts —
+        what the fleet collector stores per scrape so `delta` can
+        recover a window's distribution later."""
+        h = StreamingHistogram(self.lo, self.hi, self.growth)
+        h.counts = list(self.counts)
+        h.count = self.count
+        h.total = self.total
+        h.min = self.min
+        h.max = self.max
+        return h
+
+    def delta(self, prev: "StreamingHistogram | None") -> "StreamingHistogram":
+        """Windowed view: the histogram of samples added AFTER `prev`
+        was snapshotted (per-bucket count subtraction, clamped at 0 so
+        a reset/rolled counter degrades to the full cumulative view
+        rather than going negative). min/max of the window are not
+        recoverable from cumulative extremes, so the window's extremes
+        are estimated from its own nonzero bucket edges, clamped into
+        the cumulative [min, max]."""
+        if prev is None:
+            return self.copy()
+        if (self.lo, self.hi, self.growth) != (
+                prev.lo, prev.hi, prev.growth):
+            raise ValueError(
+                "cannot delta histograms with different bucket "
+                f"geometry: {(self.lo, self.hi, self.growth)} vs "
+                f"{(prev.lo, prev.hi, prev.growth)}"
+            )
+        h = StreamingHistogram(self.lo, self.hi, self.growth)
+        h.counts = [max(0, a - b)
+                    for a, b in zip(self.counts, prev.counts)]
+        h.count = sum(h.counts)
+        h.total = max(0.0, self.total - prev.total)
+        if h.count:
+            nz = [i for i, c in enumerate(h.counts) if c]
+            lo_i, hi_i = nz[0], nz[-1]
+            wmin = self.lo if lo_i == 0 else h._edge(lo_i)
+            wmax = self.max if hi_i == self.n + 1 else (
+                h._edge(hi_i) * self.growth
+            )
+            h.min = min(max(wmin, self.min), self.max)
+            h.max = min(max(wmax, self.min), self.max)
+        return h
+
     # -- read ----------------------------------------------------------
 
     def _edge(self, i: int) -> float:
@@ -149,6 +194,22 @@ class StreamingHistogram:
                     est = self._edge(i) * math.sqrt(self.growth)
                 return min(max(est, self.min), self.max)
         return self.max
+
+    def count_above(self, bound: float) -> int:
+        """Samples strictly in buckets whose LOWER edge is >= `bound`
+        (the SLO monitor's bad-event counter: requests over the latency
+        bound). Bucketed, so at most one bucket (~12% band at the
+        default growth) of samples straddling `bound` is miscounted —
+        the burn-rate rules tolerate that by design."""
+        if self.count == 0:
+            return 0
+        bad = self.counts[self.n + 1]  # overflow is always above
+        for i in range(1, self.n + 1):
+            if self._edge(i) >= bound:
+                bad += self.counts[i]
+        if bound <= self.lo:
+            bad += self.counts[0]
+        return bad
 
     @property
     def mean(self) -> float:
@@ -239,14 +300,27 @@ class MetricsRegistry:
                       for k in sorted(self.hists)},
         }
 
-    def to_prometheus(self, prefix: str = "") -> str:
+    def to_prometheus(self, prefix: str = "",
+                      labels: dict[str, str] | None = None,
+                      types: bool = True) -> str:
         """Prometheus text exposition format. Histogram lines are
         cumulative `_bucket{le="..."}` over the FULL fixed bucket set
         (every scrape exposes the same `le` series — a bucket
         appearing mid-run would start a new timeseries and break
         `rate()`/`histogram_quantile()` across scrapes) plus the
-        mandatory `le="+Inf"`, `_sum` and `_count`."""
+        mandatory `le="+Inf"`, `_sum` and `_count`.
+
+        `labels` stamps every series with a fixed label set (the fleet
+        exposition's `replica="N"` slicing — ISSUE 17); `types=False`
+        suppresses the `# TYPE` header lines so labeled per-replica
+        blocks can follow an already-typed merged block without
+        duplicate metadata."""
         lines: list[str] = []
+        lbl = ""
+        if labels:
+            lbl = ",".join(
+                f'{k}="{v}"' for k, v in sorted(labels.items())
+            )
 
         def _name(k: str) -> str:
             k = prefix + k
@@ -254,28 +328,41 @@ class MetricsRegistry:
                 c if c.isalnum() or c == "_" else "_" for c in k
             )
 
+        def _series(n: str, extra: str = "") -> str:
+            parts = ",".join(p for p in (lbl, extra) if p)
+            return f"{n}{{{parts}}}" if parts else n
+
         for k in sorted(self.counters):
             n = _name(k)
-            lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n} {self.counters[k]:g}")
+            if types:
+                lines.append(f"# TYPE {n} counter")
+            lines.append(f"{_series(n)} {self.counters[k]:g}")
         for k in sorted(self.gauges):
             n = _name(k)
-            lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n} {self.gauges[k]:g}")
+            if types:
+                lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{_series(n)} {self.gauges[k]:g}")
         for k in sorted(self.hists):
             h = self.hists[k]
             n = _name(k)
-            lines.append(f"# TYPE {n} histogram")
+            if types:
+                lines.append(f"# TYPE {n} histogram")
             cum = 0
             # underflow's upper bound is `lo`, then every log-bucket
             # edge; overflow folds into the +Inf line
             for i in range(h.n + 1):
                 cum += h.counts[i]
                 le = h.lo if i == 0 else h._edge(i) * h.growth
-                lines.append(f'{n}_bucket{{le="{le:g}"}} {cum}')
-            lines.append(f'{n}_bucket{{le="+Inf"}} {h.count}')
-            lines.append(f"{n}_sum {h.total:g}")
-            lines.append(f"{n}_count {h.count}")
+                edge = 'le="%g"' % le
+                lines.append(
+                    f"{_series(n + '_bucket', edge)} {cum}"
+                )
+            inf_edge = 'le="+Inf"'
+            lines.append(
+                f"{_series(n + '_bucket', inf_edge)} {h.count}"
+            )
+            lines.append(f"{_series(n + '_sum')} {h.total:g}")
+            lines.append(f"{_series(n + '_count')} {h.count}")
         return "\n".join(lines) + "\n"
 
     def export_prometheus(self, path: str, prefix: str = "") -> None:

@@ -46,6 +46,15 @@ kind) and `t` (unix seconds); the kinds the trainer/bench write:
 - `jit_compile` / `jit_compile_detail`: JIT (re)compilation events via
   `jax.monitoring` duration hooks plus the dispatch logger (the latter
   names WHICH function was traced/compiled)
+- `fleet`: a fleet-collector scoreboard snapshot (ISSUE 17) — per-
+  replica windowed rps/p99/occupancy/page-churn/quarantine-rate/
+  params-version(+lag) rows and the fleet-aggregate window, written
+  periodically by `obs.fleet.FleetCollector`
+- `alert`: an SLO burn-rate breach (ISSUE 17) — the spec name, both
+  window burn rates, the rule that fired, and the action taken
+  (`none` | `rollback`); written by `obs.slo.SLOMonitor`
+- `phase_rank`: a ranked on-device phase split (`scripts_phase_rank.py`
+  as data — per-phase device-time shares per bench row)
 
 Crash-safety: every record is flushed at write time, and open runlogs
 are closed (a final `run_end` with a `teardown` reason) from an
@@ -313,6 +322,34 @@ class RunLog:
         if phase is not None:
             fields["phase"] = phase
         self.write("memory", **(dict(stats or {}) | fields))
+
+    def fleet(self, **status: Any) -> None:
+        """One fleet-collector scoreboard snapshot (ISSUE 17): the
+        per-replica rows (rps/p99/occupancy/page churn/quarantine
+        rate/params version+lag) plus the fleet-aggregate window, as
+        `obs.fleet.FleetCollector.scrape` computed them. Periodic —
+        one record every `log_every` scrapes."""
+        self.write("fleet", **status)
+
+    def alert(self, slo: str, **fields: Any) -> None:
+        """An SLO burn-rate alert (ISSUE 17): the spec that breached
+        (`slo`), both window burn rates (`burn_long`/`burn_short`),
+        the rule's windows/factor, and the `action` taken (`none` or
+        `rollback` via the ParamBus/store facade). Written by
+        `obs.slo.SLOMonitor` at fire time, rate-limited by its
+        per-spec cooldown."""
+        self.write("alert", slo=slo, **fields)
+
+    def phase_rank(self, rows: list[dict[str, Any]],
+                   source: str | None = None, **fields: Any) -> None:
+        """A ranked on-device phase split (ISSUE 17 satellite): the
+        `scripts_phase_rank.py` table as data — per-phase share of
+        device time for each telemetry-stamped bench row — so chip-
+        session phase splits land in the same stream the ledger and
+        the fleet CLI read."""
+        if source is not None:
+            fields["source"] = source
+        self.write("phase_rank", rows=rows, **fields)
 
     # -- JIT recompile hooks ----------------------------------------------
 

@@ -48,12 +48,18 @@ class ParamBus:
         max_quarantine_rate: float = 0.5,
         runlog=None,
         metrics=None,
+        on_event=None,
     ) -> None:
         self.store = store
         self.probation_decisions = int(probation_decisions)
         self.max_quarantine_rate = float(max_quarantine_rate)
         self.runlog = runlog
         self.metrics = metrics
+        # ISSUE 17: pump-event observer (swap / rollback / proven
+        # dicts, called on the serving thread) — the online-loop depth
+        # probe's swap-to-first-decision clock hangs here
+        # (`obs.slo.OnlineLoopProbe.on_bus_event`)
+        self.on_event = on_event
         self._lock = threading.Lock()
         self._pending: tuple[Any, int] | None = None
         # version 0 (the store's construction params) is proven by
@@ -92,6 +98,12 @@ class ParamBus:
         close out a finished probation window (rollback or prove),
         then apply any pending publish. Returns an event dict when
         something happened (swap / rollback / proven), else None."""
+        event = self._pump()
+        if event is not None and self.on_event is not None:
+            self.on_event(event)
+        return event
+
+    def _pump(self) -> dict[str, Any] | None:
         event = self._check_probation()
         with self._lock:
             pending, self._pending = self._pending, None

@@ -155,10 +155,12 @@ def _replica_main(conn, idx: int, spec: ReplicaSpec) -> None:
         )
         registry = MetricsRegistry()
         cfg = dict(spec.serve_cfg)
-        # network keys ride the same `serve:` block but belong to the
-        # router/server layer — strip before the store sees them
+        # network + observability-plane keys ride the same `serve:`
+        # block but belong to the router/server layer — strip before
+        # the store sees them
         for k in ("host", "port", "replicas", "quota_sessions",
-                  "quota_inflight"):
+                  "quota_inflight", "collect", "collect_period_s",
+                  "slo"):
             cfg.pop(k, None)
         store = store_from_config(
             cfg, params, bank, scheduler, metrics=registry,
@@ -628,6 +630,32 @@ class Router:
                 if isinstance(v, (int, float)):
                     agg[k] = agg.get(k, 0) + v
         return agg
+
+    def replica_samples(self) -> list[dict[str, Any]]:
+        """Per-replica labeled scrape (ISSUE 17): ONE `metrics`
+        roundtrip per live replica returning each replica's OWN
+        registry + store stats, unmerged — the fleet collector's and
+        the labeled `/metrics` exposition's input. Dead replicas are
+        reported (alive=False) rather than dropped, so the scoreboard
+        shows the hole instead of silently shrinking."""
+        out: list[dict[str, Any]] = []
+        for r in self._replicas:
+            sample: dict[str, Any] = {
+                "replica": str(r.idx),
+                "alive": not r.dead and r.proc.is_alive(),
+                "sessions": r.sessions,
+                "registry": None,
+                "stats": None,
+            }
+            if sample["alive"]:
+                try:
+                    reg, stats = self._call(r, ("metrics",))
+                    sample["registry"] = reg
+                    sample["stats"] = stats
+                except (ReplicaDied, RuntimeError):
+                    sample["alive"] = False
+            out.append(sample)
+        return out
 
     # -- batching-front facade ---------------------------------------------
 

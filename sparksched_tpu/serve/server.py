@@ -120,6 +120,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/healthz":
             op = srv._submit_op("healthz", {})
             self._reply(op.status, op.payload)
+        elif self.path == "/fleet":
+            op = srv._submit_op("fleet", {})
+            self._reply(op.status, op.payload)
         else:
             self._reply(404, {"error": f"unknown path {self.path}",
                               "etype": "KeyError"})
@@ -156,7 +159,8 @@ class ServeServer:
     def __init__(self, store, front, *, host: str = "127.0.0.1",
                  port: int = 0, quota_sessions: int = 0,
                  quota_inflight: int = 0, metrics=None, runlog=None,
-                 on_poll=None, op_timeout_s: float = 120.0) -> None:
+                 on_poll=None, collector=None,
+                 op_timeout_s: float = 120.0) -> None:
         self.store = store
         self.front = front
         self.host = host
@@ -167,6 +171,10 @@ class ServeServer:
         self.metrics = metrics
         self.runlog = runlog
         self.on_poll = on_poll
+        # ISSUE 17: the fleet collector rides THIS pump thread
+        # (`maybe_scrape` between polls) — the store/Router stays
+        # single-owner, no scrape thread near the pipes
+        self.collector = collector
         self.op_timeout_s = float(op_timeout_s)
         self._q: queue.Queue[_Op] = queue.Queue()
         self._stop = threading.Event()
@@ -244,6 +252,8 @@ class ServeServer:
                 if self.on_poll is not None:
                     self.on_poll()
                 self.front.poll()
+                if self.collector is not None:
+                    self.collector.maybe_scrape()
             except Exception:  # keep pumping: one bad poll must not
                 self._count("serve_http_errors")  # strand handlers
                 time.sleep(0.01)
@@ -271,7 +281,7 @@ class ServeServer:
             handler = {
                 "create": self._op_create, "decide": self._op_decide,
                 "close": self._op_close, "metrics": self._op_metrics,
-                "healthz": self._op_healthz,
+                "healthz": self._op_healthz, "fleet": self._op_fleet,
             }[op.kind]
             handler(op, tracked)
         except Exception as e:  # never kill the pump on one bad op
@@ -382,7 +392,25 @@ class ServeServer:
     def _op_metrics(self, op: _Op, tracked: list) -> None:
         from ..obs.metrics import MetricsRegistry
 
-        if hasattr(self.store, "registry"):  # Router: fleet merge
+        if hasattr(self.store, "replica_samples"):
+            # Router fleet (ISSUE 17): merged totals first (the PR-16
+            # backward-compatible block), then each replica's own
+            # series labeled `replica="N"` — per-replica axes survive
+            # the exposition instead of dying in the merge
+            from ..obs.fleet import labeled_prometheus
+
+            extra = MetricsRegistry()
+            own = getattr(self.store, "metrics", None)
+            if own is not None:
+                extra.merge(own)
+            if self.metrics is not None:
+                extra.merge(self.metrics)
+            op.status = 200
+            op.payload = {"text": labeled_prometheus(
+                self.store.replica_samples(), extra=extra)}
+            op.event.set()
+            return
+        if hasattr(self.store, "registry"):  # fleet-merge facade
             agg = self.store.registry()
         else:
             agg = MetricsRegistry()
@@ -393,6 +421,24 @@ class ServeServer:
             agg.merge(self.metrics)
         op.status = 200
         op.payload = {"text": agg.to_prometheus()}
+        op.event.set()
+
+    def _op_fleet(self, op: _Op, tracked: list) -> None:
+        """The `/fleet` scoreboard (ISSUE 17): the collector's last
+        status (scraping now if none yet) — runs on the pump thread
+        like every op, so the scrape itself keeps the single-owner
+        discipline."""
+        if self.collector is None:
+            op.status = 404
+            op.payload = {"error": "no fleet collector configured "
+                                   "(serve: collect: true)",
+                          "etype": "KeyError"}
+            op.event.set()
+            return
+        from ..obs.fleet import _json_safe
+
+        op.status = 200
+        op.payload = _json_safe(self.collector.fleet_status())
         op.event.set()
 
     def _op_healthz(self, op: _Op, tracked: list) -> None:
@@ -721,6 +767,33 @@ def server_from_config(
         "quota_inflight": int(cfg.get("quota_inflight", 0)),
     }
     net_kw.update(overrides)
+    # ISSUE 17: `collect: true` attaches the fleet collector (scrapes
+    # ride the pump thread, `/fleet` serves the scoreboard); `slo:`
+    # declares the burn-rate monitor the collector feeds. An `slo:`
+    # block without the collector would be silently disarmed — fail
+    # loudly instead (the serve-config contract).
+    collect = bool(cfg.get("collect", False))
+    if cfg.get("slo") and not collect:
+        raise ValueError(
+            "serve: slo: needs collect: true (the SLO monitor is "
+            "evaluated by the fleet collector's scrape loop)"
+        )
+
+    def _attach_collector(backend) -> None:
+        if not collect:
+            return
+        from ..obs.fleet import FleetCollector
+        from ..obs.slo import slo_from_config
+
+        runlog = net_kw.get("runlog")
+        monitor = slo_from_config(
+            cfg.get("slo"), rollback=backend, runlog=runlog)
+        net_kw["collector"] = FleetCollector(
+            backend,
+            period_s=float(cfg.get("collect_period_s", 1.0)),
+            runlog=runlog, slo=monitor,
+        )
+
     if replicas > 0:
         from .router import Router
 
@@ -732,10 +805,13 @@ def server_from_config(
                 "params/bank/scheduler objects"
             )
         router = Router(replica_spec, replicas=replicas)
+        _attach_collector(router)
         return ServeServer(router, router, **net_kw)
     store_cfg = {k: v for k, v in cfg.items()
                  if k not in ("host", "port", "replicas",
-                              "quota_sessions", "quota_inflight")}
+                              "quota_sessions", "quota_inflight",
+                              "collect", "collect_period_s", "slo")}
     store = store_from_config(store_cfg, params, bank, scheduler)
     front = front_from_config(store_cfg, store)
+    _attach_collector(store)
     return ServeServer(store, front, **net_kw)
