@@ -67,7 +67,8 @@ MEMFIT = os.environ.get("BENCH_MEMFIT", "1") == "1"
 # ISSUE 17 satellite: resume the headline bench series. Every row any
 # bench in this file emits is also collected here, and main() writes
 # the lot as a top-level `BENCH_rNN.json` summary (round from
-# BENCH_ROUND, default 19 — the series stalled at BENCH_r05.json).
+# BENCH_ROUND, default 20 — the ISSUE 18 ring round; the series
+# resumed at r19 after stalling at BENCH_r05.json).
 # The perf ledger (sparksched_tpu/obs/ledger.py) indexes that file as
 # the round's anchor. BENCH_SUMMARY=0 skips the write (sub-benches
 # invoked standalone by other harnesses should not stamp a round).
@@ -86,7 +87,7 @@ def _emit_row(row: dict) -> None:
 def _write_bench_summary(quiet: bool = False) -> None:
     if os.environ.get("BENCH_SUMMARY", "1") != "1":
         return
-    rnd = int(os.environ.get("BENCH_ROUND", "19"))
+    rnd = int(os.environ.get("BENCH_ROUND", "20"))
     # carried headline anchors: the standing in-process serving
     # headlines, restated at this round so the series carries them
     # forward explicitly. `carried: true` + `source` mark them as
@@ -506,7 +507,7 @@ def bench_serve_latency(
     capacity: int | None = None,
     max_batch: int | None = None,
     reps: int | None = None,
-    artifact: str = "artifacts/serve_latency_r10.json",
+    artifact: str = "artifacts/serve_latency_r20.json",
 ) -> list[dict]:
     """Decision-serving latency (ISSUE 10): p50/p90/p99 per-decision
     wall time through the AOT session store (`sparksched_tpu/serve/`),
@@ -570,7 +571,7 @@ def bench_serve_latency(
     }
     rows: list[dict] = []
 
-    def wall_split_block(ws0: dict, n_calls: int) -> dict:
+    def wall_split_block(ws0: dict, n_calls: int, st=None) -> dict:
         """ISSUE 15 satellite: the timed window's wall time split into
         `dispatch_wall` (issuing compiled calls — async, returns
         futures) vs `blocked_host_wall` (inside
@@ -579,9 +580,10 @@ def bench_serve_latency(
         percentile fields are untouched; this block sits NEXT TO them
         so pipeline overlap (a shrinking blocked share) is visible in
         the row schema."""
-        d_ms = (store.wall_split["dispatch_s"] - ws0["dispatch_s"]) * 1e3
+        st = store if st is None else st
+        d_ms = (st.wall_split["dispatch_s"] - ws0["dispatch_s"]) * 1e3
         b_ms = (
-            store.wall_split["blocked_host_s"] - ws0["blocked_host_s"]
+            st.wall_split["blocked_host_s"] - ws0["blocked_host_s"]
         ) * 1e3
         return {
             "dispatch_wall_ms": round(d_ms, 3),
@@ -639,8 +641,98 @@ def bench_serve_latency(
             store.close(one)
             one = store.create(seed=4000 + i)
     store.close(one)
+    ws_off = wall_split_block(ws0, reps)
     emit("serve_decide_latency_batch1", samples, {"batch": 1},
-         wall_split=wall_split_block(ws0, reps))
+         wall_split=ws_off)
+
+    # --- ISSUE 18: the record-path A/B at batch=1 — the same reps
+    # window on a record-on store, once through the per-decision
+    # path (`record=True`, every decide syncs its StoredObs payload
+    # to the host) and once through the device-resident trajectory
+    # ring (`ring=R`: decides append on-device, the host drains ONE
+    # batched transfer every ring_drain decisions). The headline the
+    # ring exists for is the `blocked_host_wall_record_*` family
+    # emitted below: per-call host-blocked wall, record-off vs the
+    # two record paths — the ring row must sit in the noise of the
+    # record-off row. Both arms feed a real TrajectoryBuffer, so the
+    # measured path is the online actor's, not a null sink.
+    from sparksched_tpu.online.trajectory import TrajectoryBuffer
+
+    ring_size = int(os.environ.get(
+        "SERVE_BENCH_RING", 4 * max_batch
+    ))
+    rec_ws: dict[str, dict] = {}
+    rec_ring_stats: dict[str, dict] = {}
+    for label, extra in (
+        ("legacy", {}),
+        ("ring", {"ring": ring_size}),
+    ):
+        buf = TrajectoryBuffer(max_steps=16)
+        t0r = time.perf_counter()
+        st = SessionStore(
+            params, bank, sched, capacity=capacity,
+            max_batch=max_batch, deterministic=True, seed=0,
+            runlog=runlog, record=True, collector=buf, **extra,
+        )
+        rec_cold_s = time.perf_counter() - t0r
+        one = st.create(seed=3000)
+        samples = []
+        ws0 = dict(st.wall_split)
+        for i in range(reps):
+            t1 = time.perf_counter()
+            r = st.decide(one)
+            samples.append((time.perf_counter() - t1) * 1e3)
+            if r.done or r.health_mask:
+                st.close(one)
+                one = st.create(seed=4000 + i)
+        st.close(one)
+        if getattr(st, "_ring_on", False):
+            st.drain_ring(wait=True)
+        rec_ws[label] = wall_split_block(ws0, reps, st=st)
+        rec_ring_stats[label] = {
+            k: int(st.stats[k]) for k in (
+                "serve_ring_occupancy", "serve_ring_drains",
+                "serve_ring_records", "serve_ring_dropped",
+            )
+        }
+        emit(
+            f"serve_decide_latency_batch1_record_{label}", samples,
+            {
+                "batch": 1, "record": True,
+                "ring": extra.get("ring", 0),
+                "ring_drain": getattr(st, "ring_drain", None)
+                if extra else None,
+                "record_cold_start_s": round(rec_cold_s, 3),
+                "trajectories": dict(buf.stats),
+                "ring_stats": rec_ring_stats[label],
+            },
+            wall_split=rec_ws[label],
+        )
+
+    # the ledger family: per-call blocked-host wall as its own rows,
+    # so the cross-round trend (and the tier-1 round pin) reads the
+    # record path's sync cost directly instead of digging through
+    # wall_split blocks
+    for metric, ws, cfg_extra in (
+        ("blocked_host_wall_record_off", ws_off,
+         {"batch": 1, "record": False}),
+        ("blocked_host_wall_record_legacy", rec_ws["legacy"],
+         {"batch": 1, "record": True, "ring": 0}),
+        ("blocked_host_wall_record_on", rec_ws["ring"],
+         {"batch": 1, "record": True, "ring": ring_size,
+          "ring_stats": rec_ring_stats["ring"]}),
+    ):
+        row = {
+            "metric": metric,
+            "value": ws["blocked_host_wall_ms_per_call"],
+            "unit": "ms",
+            "wall_split": ws,
+            "analysis_clean": analysis_clean_stamp(),
+            "config": base_cfg | cfg_extra,
+            "on_chip": _on_chip_block(),
+        }
+        rows.append(row)
+        _emit_row(row)
 
     # --- batch=K: one compiled width-K call per timed rep ---
     samples = []
@@ -699,6 +791,22 @@ def bench_serve_latency(
                 "cold_start": "AOT lower+compile (both programs) + "
                               "first-dispatch warmup",
                 "linger_sweep_ms": lingers,
+                # ISSUE 18: the record-path A/B — same reps window on
+                # record-on stores (per-decision vs device ring), the
+                # blocked_host_wall_record_* rows are the per-call
+                # host-blocked wall of each path
+                "record_ab": {
+                    "ring": ring_size,
+                    "arms": ["off", "legacy", "ring"],
+                    "blocked_host_wall_ms_per_call": {
+                        "off": ws_off[
+                            "blocked_host_wall_ms_per_call"],
+                        "legacy": rec_ws["legacy"][
+                            "blocked_host_wall_ms_per_call"],
+                        "ring": rec_ws["ring"][
+                            "blocked_host_wall_ms_per_call"],
+                    },
+                },
             },
             "rows": rows,
         }, fp, indent=1)
@@ -789,7 +897,7 @@ def _serve_obs_overhead(store, reps: int = 30) -> dict:
 
 
 def bench_serve_scale(
-    artifact: str = "artifacts/serve_scale_r18.json",
+    artifact: str = "artifacts/serve_scale_r20.json",
 ) -> list[dict]:
     """Serving at load (ISSUE 11/13): open-loop offered-load sweep
     over the AOT session store, reporting GOODPUT under a p99 SLO —
@@ -938,6 +1046,19 @@ def bench_serve_scale(
             )
     cold_start_s = time.perf_counter() - t0
     hot_set = store.hot_set_advice()
+
+    def ring_block(st) -> dict:
+        """ISSUE 18: the store's device-ring counters, stamped on
+        every row so a record-on arm's drain cadence (and any overrun
+        drops) travels with the goodput it produced. Record-off
+        stores stamp zeros — the zero IS the claim that the arm never
+        touched the ring path."""
+        return {
+            k: int(st.stats.get(k, 0)) for k in (
+                "serve_ring_occupancy", "serve_ring_drains",
+                "serve_ring_records", "serve_ring_dropped",
+            )
+        }
 
     base_cfg = {
         "capacity": capacity,
@@ -1113,6 +1234,9 @@ def bench_serve_scale(
                         snap["counters"].get("serve_page_outs", 0)
                     ),
                 },
+                "ring": ring_block(
+                    store_pipe if front == "pipelined" else store
+                ),
                 "analysis_clean": analysis_clean_stamp(),
                 "config": base_cfg | {
                     "offered_rps": rate, "process": process,
@@ -1151,12 +1275,21 @@ def bench_serve_scale(
         # programs) — one shared definition, never a copy
         agent_cfg = {"agent_cls": "DecimaScheduler"} | SERVE_AGENT_KWARGS
         reg = MetricsRegistry()
+        # ISSUE 18: the record arm runs through the device-resident
+        # trajectory ring by default — decides append on-device, the
+        # host drains one batched transfer per cadence, so the online
+        # loop's record cost is the ring drain, not a per-decision
+        # sync. SERVE_SCALE_RING=0 restores the r16 per-decision path
+        # (the before arm of the PERF.md round-20 table).
+        ring_size = int(os.environ.get(
+            "SERVE_SCALE_RING", 8 * max_batch
+        ))
         t0o = time.perf_counter()
         store_on = SessionStore(
             params, bank, sched, capacity=capacity,
             hot_capacity=hot_capacity, max_batch=max_batch,
             deterministic=True, seed=0, runlog=runlog, metrics=reg,
-            record=True,
+            record=True, ring=ring_size,
         )
         online_cold_s = time.perf_counter() - t0o
         buffer, learner, bus = online_from_config(
@@ -1299,6 +1432,7 @@ def bench_serve_scale(
             },
             "latency": lat_block | {"hist": hist_summary(hist_on)},
             "online": online_block,
+            "ring": ring_block(store_on),
             "record_overhead": {
                 "open_loop_pct": round(rec_pct, 2),
                 "mean_ms": {
@@ -1313,6 +1447,8 @@ def bench_serve_scale(
             "config": base_cfg | {
                 "offered_rps": on_rate, "process": "poisson",
                 "front": "continuous", "record": True,
+                "ring": ring_size,
+                "ring_drain": store_on.ring_drain,
                 "online_cold_start_s": round(online_cold_s, 3),
                 "learner_compile_s": round(learner_compile_s, 3),
             },
@@ -1332,8 +1468,14 @@ def bench_serve_scale(
             "offered_rps": on_rate,
             "record_ab": "record-on vs record-off store at the same "
                          "seeded offered load, arms interleaved "
-                         "rep-by-rep, median per-rep mean latency",
+                         "rep-by-rep, median per-rep mean latency; "
+                         "since r20 the record arm runs the device "
+                         "trajectory ring (ISSUE 18), so the "
+                         "overhead is the batched drain, not a "
+                         "per-decision sync",
             "record_overhead_pct": round(rec_pct, 2),
+            "ring": {"size": ring_size,
+                     "drain": store_on.ring_drain},
             "hot_swaps": online_block["hot_swaps"],
             "learner_steps": online_block["learner_steps"],
         }
@@ -1395,7 +1537,8 @@ def bench_serve_scale(
                 sorted(p99s)[len(p99s) // 2], goodputs, p99s,
             )
 
-        def net_row(metric, pair, arm, med, net_block, cfg_extra):
+        def net_row(metric, pair, arm, med, net_block, cfg_extra,
+                    ring=None):
             s_med, lat, h, med_p99, goodputs, p99s = med
             return {
                 "metric": metric,
@@ -1428,6 +1571,8 @@ def bench_serve_scale(
                 } | {"reconcile": s_med.get("reconcile")},
                 "latency": lat | {"hist": hist_summary(h)},
                 "net": net_block,
+                "ring": ring if ring is not None
+                else ring_block(store),
                 "analysis_clean": analysis_clean_stamp(),
                 "config": base_cfg | {
                     "offered_rps": net_rate, "process": "poisson",
@@ -1498,6 +1643,9 @@ def bench_serve_scale(
                         lb_cold_s if label == "loopback" else 0.0, 3
                     ),
                 },
+                ring=ring_block(
+                    store_lb if label == "loopback" else store
+                ),
             )
             rows.append(row)
             _emit_row(row)
@@ -1570,6 +1718,14 @@ def bench_serve_scale(
                     "capacity": fleet_capacity,
                     "max_batch": fleet_batch,
                     "cold_start_s": round(boot_s, 3),
+                },
+                # fleet_stats sums replica stats, so the ring block
+                # here is the FLEET's aggregate drain accounting
+                ring={
+                    k: int(fleet.get(k, 0)) for k in (
+                        "serve_ring_occupancy", "serve_ring_drains",
+                        "serve_ring_records", "serve_ring_dropped",
+                    )
                 },
             )
             rows.append(row)

@@ -933,6 +933,69 @@ def stage_serve_net():
     print(f"[serve-net] subprocess rc={r.returncode}", flush=True)
 
 
+def stage_serve_ring():
+    """ISSUE 18: the record-path A/B at chip scale — the 1024-session
+    store's batch=1 window served record-off, record-on through the
+    per-decision path, and record-on through the device-resident
+    trajectory ring, emitting the `blocked_host_wall_record_*` family
+    (per-call host-blocked wall) + the record latency rows, written
+    to artifacts/serve_ring_chip.json. On a chip the per-decision
+    record path pays a device->host sync per decide, so this stage is
+    where the ring's batched-drain claim is actually proven at scale
+    (the CPU A/B in artifacts/serve_latency_r20.json / PERF.md round
+    20 bounds the host-glue share only). Runs ENTIRELY in a
+    subprocess, gate included; a chipless host prints an explicit
+    `[serve-ring] UNAVAILABLE` marker and exits 0 — the watcher log
+    must distinguish "no window" from "never ran"."""
+    import os
+    import os.path as osp
+    import subprocess
+    import sys
+
+    if _client_held():
+        print("[serve-ring] parent process already holds a device "
+              "client; run stage 19 as its own invocation", flush=True)
+        return
+    repo = osp.dirname(osp.abspath(__file__))
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from sparksched_tpu.config import (\n"
+        "    enable_compilation_cache, honor_jax_platforms_env,\n"
+        "    use_fast_prng,\n"
+        ")\n"
+        "honor_jax_platforms_env()\n"
+        "enable_compilation_cache()\n"
+        "if os.environ.get('BENCH_PRNG', 'rbg') == 'rbg':\n"
+        "    use_fast_prng()\n"
+        "import jax\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    print('[serve-ring] UNAVAILABLE: cpu backend only; the "
+        "chip-scale record-path A/B needs a chip window (the CPU A/B "
+        "is recorded in artifacts/serve_latency_r20.json and PERF.md "
+        "round 20)', flush=True)\n"
+        "    sys.exit(0)\n"
+        "import bench_decima\n"
+        "bench_decima.bench_serve_latency(\n"
+        "    artifact='artifacts/serve_ring_chip.json')\n"
+    )
+    env = os.environ | {
+        # stage-14 chip store scale; the ring sized for the chip
+        # decision rate (drain cadence defaults to ring/2, so 8
+        # batched transfers per 1024 decisions)
+        "SERVE_BENCH_CAPACITY": os.environ.get(
+            "SERVE_BENCH_CAPACITY", "1024"
+        ),
+        "SERVE_BENCH_BATCH": os.environ.get("SERVE_BENCH_BATCH", "16"),
+        "SERVE_BENCH_REPS": os.environ.get("SERVE_BENCH_REPS", "300"),
+        "SERVE_BENCH_RING": os.environ.get("SERVE_BENCH_RING", "256"),
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, timeout=2700, env=env,
+    )
+    print(f"[serve-ring] subprocess rc={r.returncode}", flush=True)
+
+
 # ---------------------------------------------------------------------------
 # stage-completion ledger (ISSUE 9 preemption safety)
 # ---------------------------------------------------------------------------
@@ -1012,6 +1075,7 @@ STAGES = {
     "16": ("continuous-batching A/B capture", stage_serve_cb),
     "17": ("pipelined-serve A/B capture", stage_serve_pipe),
     "18": ("network serving tier capture", stage_serve_net),
+    "19": ("ring record-path A/B capture", stage_serve_ring),
 }
 
 
@@ -1045,11 +1109,11 @@ if __name__ == "__main__":
                 print("chip unavailable; aborting session", flush=True)
                 break
         finally:
-            # 7, 12, 13, 14, 15, 16, 17 and 18 run in subprocesses
-            # and 10 is CPU-subprocess-only: none takes the in-process
-            # device client
+            # 7, 12, 13, 14, 15, 16, 17, 18 and 19 run in
+            # subprocesses and 10 is CPU-subprocess-only: none takes
+            # the in-process device client
             if p not in ("7", "10", "12", "13", "14", "15", "16",
-                         "17", "18"):
+                         "17", "18", "19"):
                 _mark_client_held()
             if ledger_path:
                 ledger[p] = {

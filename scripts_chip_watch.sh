@@ -41,6 +41,8 @@ rm -f /tmp/serve_cb_done
 rm -f /tmp/serve_pipe_done
 # ... and for the network serving tier capture (stage 18, ISSUE 16)
 rm -f /tmp/serve_net_done
+# ... and for the ring record-path A/B capture (stage 19, ISSUE 18)
+rm -f /tmp/serve_ring_done
 # stage-completion ledger (ISSUE 9): per-LIFETIME like the markers
 # above — a restarted watcher must re-run its multi-stage sessions, not
 # inherit a previous lifetime's completions (the ledger's job is
@@ -329,6 +331,25 @@ print('ALIVE')
       echo "serve-net rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
       grep -q '"backend": "tpu"' /tmp/serve_net_last.log \
         && touch "$SERVE_NET_MARK"
+    fi
+    [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
+    # one-time ring record-path A/B capture (ISSUE 18, stage 19): the
+    # 1024-session store's batch=1 window record-off vs per-decision
+    # record vs the device trajectory ring — the on-chip proof of the
+    # blocked_host_wall_record_* family (the per-decision path pays a
+    # device->host sync per decide on real silicon; the CPU A/B in
+    # artifacts/serve_latency_r20.json / PERF.md round 20 bounds the
+    # host-glue share only), queued behind the 13-18 slots. Once per
+    # watcher lifetime; marked done only when a TPU-backed row landed
+    # (an UNAVAILABLE marker means no window yet — retry next loop,
+    # like the earlier slots).
+    SERVE_RING_MARK=/tmp/serve_ring_done
+    if [ ! -f "$SERVE_RING_MARK" ]; then
+      timeout -k 60 2800 python scripts_chip_session.py 19 \
+        | tee /tmp/serve_ring_last.log
+      echo "serve-ring rc=${PIPESTATUS[0]} at $(date +%H:%M:%S)"
+      grep -q '"backend": "tpu"' /tmp/serve_ring_last.log \
+        && touch "$SERVE_RING_MARK"
     fi
     [ -f /tmp/stop_chip_watch ] && { echo "stop file; exiting"; exit 0; }
     # flagship-scale training with whatever window remains: resumable

@@ -17,15 +17,24 @@ nothing here is asserted on faith:
    interleaved rep-by-rep — the PR-11 protocol), with a warm
    batch-window A/B alongside as the queueing-free measure.
 
-Artifact: artifacts/online_loop_r16.json — swap/rollback counts and
+Since round 20 (ISSUE 18) the record path runs through the
+device-resident trajectory ring: decides append their full record
+into a donated on-device ring and the host drains ONE batched
+transfer per cadence, so the loop's record cost is the drain, not a
+per-decision sync. ONLINE_LOOP_RING=0 restores the r16 per-decision
+path; the artifact stamps the ring counters (occupancy / drains /
+records / dropped — drops are counted, never silent).
+
+Artifact: artifacts/online_loop_r20.json — swap/rollback counts and
 the zero-recompile pin, learner steps with losses and the per-update
 reward trend, trajectory-buffer accounting (drops are counted, never
-silent), and the record-overhead A/B block. PERF.md round 16
-documents the row schema.
+silent), the ring drain accounting, and the record-overhead A/B
+block. PERF.md rounds 16/20 document the row schema.
 
 Env knobs: ONLINE_LOOP_REQUESTS (default 240), ONLINE_LOOP_RATE_RPS
 (25), ONLINE_LOOP_TENANTS (4), ONLINE_LOOP_AB_REPS (5),
-ONLINE_LOOP_SLO_MS (200).
+ONLINE_LOOP_SLO_MS (200), ONLINE_LOOP_RING (16; 0 = per-decision
+record path).
 """
 
 from __future__ import annotations
@@ -64,7 +73,7 @@ from sparksched_tpu.serve import (  # noqa: E402
 )
 from sparksched_tpu.workload import make_workload_bank  # noqa: E402
 
-ARTIFACT = "artifacts/online_loop_r16.json"
+ARTIFACT = "artifacts/online_loop_r20.json"
 
 AGENT_CFG = {
     "agent_cls": "DecimaScheduler",
@@ -126,6 +135,7 @@ def main() -> int:
     tenants = int(os.environ.get("ONLINE_LOOP_TENANTS", 4))
     ab_reps = int(os.environ.get("ONLINE_LOOP_AB_REPS", 7))
     slo_ms = float(os.environ.get("ONLINE_LOOP_SLO_MS", 200))
+    ring_size = int(os.environ.get("ONLINE_LOOP_RING", 16))
     seed = 11
 
     params, bank, sched = _setup()
@@ -134,7 +144,8 @@ def main() -> int:
     t0 = time.perf_counter()
     store = SessionStore(
         params, bank, sched, capacity=2 * tenants, max_batch=4,
-        seed=0, record=True, runlog=runlog, metrics=reg,
+        seed=0, record=True, ring=ring_size, runlog=runlog,
+        metrics=reg,
     )
     cold_s = time.perf_counter() - t0
     buffer, learner, bus = online_from_config(
@@ -290,7 +301,8 @@ def main() -> int:
     artifact = {
         "protocol": {
             "loop": "open-loop seeded schedule through a record-on "
-                    "ContinuousBatcher store; background learner "
+                    "ring-drained ContinuousBatcher store; "
+                    "background learner "
                     "thread drains trajectories and publishes via "
                     "ParamBus; swaps applied between compiled calls "
                     "(run_open_loop on_poll)",
@@ -323,6 +335,20 @@ def main() -> int:
             "rollbacks": store.stats["serve_param_rollbacks"],
             "zero_recompile": len(compiles) == 0,
             "jit_compile_records": len(compiles),
+            # ISSUE 18: the ring drain accounting for the whole run —
+            # records is every decision that rode the device ring,
+            # dropped counts overrun losses (must be 0 at the default
+            # cadence)
+            "ring": {
+                "size": ring_size,
+                "drain": getattr(store, "ring_drain", None),
+                **{
+                    k: int(store.stats[k]) for k in (
+                        "serve_ring_occupancy", "serve_ring_drains",
+                        "serve_ring_records", "serve_ring_dropped",
+                    )
+                },
+            },
         },
         "learner": {
             "steps": learner.stats["learner_steps"],

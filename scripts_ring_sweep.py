@@ -1,0 +1,130 @@
+"""Drain-cadence sweep for the device-resident trajectory ring.
+
+ISSUE 18 satellite: the ring collapses record-on `blocked_host_wall`
+to the record-off floor by amortizing one batched device->host
+transfer over `ring_drain` decisions. This script measures that
+amortization curve: batch-1 decide latency and per-call blocked-host
+wall at a fixed ring depth across a sweep of drain cadences, on ONE
+record-on store — `ring_drain` is a host-side cadence (it never
+enters the compiled program), so sweeping it costs zero recompiles.
+That zero is the knob's whole value: operators tune drain freshness
+vs host tax live, without touching the AOT cache.
+
+Protocol: paired on one store (same compiled program, same session
+rotation) — per arm, `reps` sequential batch-1 decides with a
+terminal-episode rotation, then a forced `drain_ring(wait=True)` so
+every arm ends at occupancy 0 and no arm inherits a predecessor's
+backlog. The first arm is re-run once and the cold pass discarded
+(warmup). Rows land in `artifacts/ring_drain_sweep_r20.json` with the
+`blocked_host_wall` per call, drain count, and p50 per arm.
+
+Env knobs: RING_SWEEP_CAPACITY (64), RING_SWEEP_BATCH (8),
+RING_SWEEP_REPS (150), RING_SWEEP_RING (32),
+RING_SWEEP_DRAINS ("1,2,4,8,16,32"), RING_SWEEP_ARTIFACT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> int:
+    import jax
+
+    from bench_decima import _latency_block, _serve_setup
+    from sparksched_tpu.online.trajectory import TrajectoryBuffer
+    from sparksched_tpu.serve import SessionStore
+
+    capacity = int(os.environ.get("RING_SWEEP_CAPACITY", 64))
+    max_batch = int(os.environ.get("RING_SWEEP_BATCH", 8))
+    reps = int(os.environ.get("RING_SWEEP_REPS", 150))
+    ring = int(os.environ.get("RING_SWEEP_RING", 32))
+    drains = [
+        int(x) for x in os.environ.get(
+            "RING_SWEEP_DRAINS", "1,2,4,8,16,32"
+        ).split(",") if x.strip()
+    ]
+    artifact = os.environ.get(
+        "RING_SWEEP_ARTIFACT", "artifacts/ring_drain_sweep_r20.json"
+    )
+
+    params, bank, sched = _serve_setup()
+    buf = TrajectoryBuffer(max_steps=16)
+    t0 = time.perf_counter()
+    store = SessionStore(
+        params, bank, sched, capacity=capacity, max_batch=max_batch,
+        deterministic=True, seed=0, record=True, collector=buf,
+        ring=ring,
+    )
+    cold_start_s = time.perf_counter() - t0
+
+    def arm(drain: int, seed_base: int) -> dict:
+        # `ring_drain` is pure host cadence — mutating it between arms
+        # is exactly the live-tuning path the knob exists for. Keep it
+        # inside the ctor's own bound (1..ring) so the sweep can never
+        # outrun what the constructor would have accepted.
+        assert 1 <= drain <= ring, drain
+        store.ring_drain = drain
+        one = store.create(seed=seed_base)
+        samples = []
+        ws0 = dict(store.wall_split)
+        drains0 = int(store.stats["serve_ring_drains"])
+        for i in range(reps):
+            t1 = time.perf_counter()
+            r = store.decide(one)
+            samples.append((time.perf_counter() - t1) * 1e3)
+            if r.done or r.health_mask:
+                store.close(one)
+                one = store.create(seed=seed_base + 1 + i)
+        store.close(one)
+        store.drain_ring(wait=True)
+        ws = store.wall_split
+        b_ms = (ws["blocked_host_s"] - ws0["blocked_host_s"]) * 1e3
+        d_ms = (ws["dispatch_s"] - ws0["dispatch_s"]) * 1e3
+        lat = _latency_block(samples, len(samples))
+        return {
+            "metric": f"blocked_host_wall_ring_drain{drain}",
+            "value": round(b_ms / reps, 4),
+            "unit": "ms",
+            "ring_drain": drain,
+            "p50_ms": lat["p50_ms"],
+            "p99_ms": lat["p99_ms"],
+            "dispatch_wall_ms_per_call": round(d_ms / reps, 4),
+            "drains": int(store.stats["serve_ring_drains"]) - drains0,
+            "ring_dropped": int(store.stats["serve_ring_dropped"]),
+        }
+
+    arm(drains[0], seed_base=9000)  # warmup pass, discarded
+    rows = [
+        arm(d, seed_base=10_000 + 1000 * i)
+        for i, d in enumerate(drains)
+    ]
+    out = {
+        "protocol": {
+            "note": (
+                "paired drain-cadence sweep on ONE record-on store "
+                "(ring_drain is host cadence, zero recompiles across "
+                "arms); each arm is reps batch-1 decides + a forced "
+                "final drain so arms start at occupancy 0"
+            ),
+            "capacity": capacity, "max_batch": max_batch,
+            "reps": reps, "ring": ring,
+            "cold_start_s": round(cold_start_s, 3),
+            "backend": jax.default_backend(),
+        },
+        "rows": rows,
+    }
+    os.makedirs(os.path.dirname(artifact), exist_ok=True)
+    with open(artifact, "w") as f:
+        json.dump(out, f, indent=1)
+    for r in rows:
+        print(json.dumps(r))
+    print(f"# ring sweep: wrote {artifact} ({len(rows)} arms)")
+    assert all(r["ring_dropped"] == 0 for r in rows)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

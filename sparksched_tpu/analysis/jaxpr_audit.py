@@ -223,6 +223,26 @@ BUDGETS: dict[str, Budget] = {
     "serve_decide_batch_group": Budget(
         eqn_lo=6000, eqn_hi=17400, gather_hi=339, scatter_hi=88,
     ),
+    # ISSUE 18: the ring-recording serve programs (serve/aot.py
+    # `serve_decide_ring_fn` / `serve_decide_batch_ring_fn` — the
+    # device-resident trajectory path), pinned 2026-08-07 —
+    # serve_decide_record_ring 6648/33/86,
+    # serve_decide_batch_record_ring 12996/252/86. The +21 scatters
+    # over the record programs are structural: ring_append writes
+    # each of the 21 RingRec leaves (12 decision scalars + the
+    # StoredObs pieces) at the masked cursor position — one
+    # dynamic-update per leaf, in-place under ring donation, with the
+    # drop-mode lane for masked-off appends. +~130 eqns are the
+    # cursor/offset arithmetic and the record assembly. Every
+    # record-OFF and record-on-ring-OFF serve program above
+    # re-measured BYTE-IDENTICAL in the same PR — the zero-cost-off
+    # acceptance bar.
+    "serve_decide_record_ring": Budget(
+        eqn_lo=3000, eqn_hi=8980, gather_hi=45, scatter_hi=117,
+    ),
+    "serve_decide_batch_record_ring": Budget(
+        eqn_lo=6000, eqn_hi=17550, gather_hi=341, scatter_hi=117,
+    ),
 }
 
 
@@ -577,6 +597,7 @@ def program_callables(names: tuple[str, ...] | None = None
         "serve_decide", "serve_decide_batch",
         "serve_decide_batch_sharded", "serve_decide_record",
         "serve_decide_batch_record", "serve_decide_batch_group",
+        "serve_decide_record_ring", "serve_decide_batch_record_ring",
     }:
         # ISSUE 10/13: the AOT decision service's programs (serving
         # store capacity 8, micro-batch width 4 at audit scale; the

@@ -173,6 +173,18 @@ MEM_BUDGETS: dict[str, MemBudget] = {
     # lowering never starts materializing cross-group state (a
     # concatenated all-groups view would double here immediately).
     "serve_decide_batch_group": MemBudget(temp_hi=440 * MB),
+    # ISSUE 18 ring-record serve variants (pinned 2026-08-07): 59.9 MB
+    # / 327.3 MB vs 59.3 / 326.7 for the per-decision record programs —
+    # the trajectory ring rides in the donated ARGS (one [R,...] RingRec
+    # pytree, ~0.5 MB at the audit R), and the append is a single
+    # masked scatter per leaf into that donated buffer, so temp bytes
+    # barely move. The band pins that the ring append never starts
+    # materializing a ring-sized temporary: a lowering that copies the
+    # [R,...] ring to stage the append (instead of scattering in place)
+    # would add the full ring bytes here and breach on CPU before a
+    # record-on serve deploy ever pages it.
+    "serve_decide_record_ring": MemBudget(temp_hi=82 * MB),
+    "serve_decide_batch_record_ring": MemBudget(temp_hi=443 * MB),
 }
 
 # lane counts the advisor sweeps (the bench's production range; 1024
@@ -382,7 +394,8 @@ def audit_memory(
     # capacity — the hot-set axis has its own advisor,
     # obs.memory.hot_set_fit.)
     for sname in ("serve_decide_batch", "serve_decide_batch_sharded",
-                  "serve_decide_batch_group"):
+                  "serve_decide_batch_group",
+                  "serve_decide_batch_record_ring"):
         if names is not None and sname not in names:
             continue
         from ..serve.aot import SERVE_AUDIT_BATCH

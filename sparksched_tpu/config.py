@@ -155,6 +155,9 @@ SERVE_KEYS = frozenset({
     # ISSUE 14: the online learning loop's serve-side knobs
     "record",  # compile the record-on programs (per-decision StoredObs)
     "pager_aware",  # continuous front: prefer hot sessions in batches
+    # ISSUE 18: the device-resident trajectory ring (record-on only)
+    "ring",  # ring depth R (records); 0 = per-decision record path
+    "ring_drain",  # drain cadence in decisions (default: ring // 2)
     # ISSUE 15: pipelined serve execution
     "groups",  # independently-donated slot groups (in-flight width)
     "depth",  # `front: pipelined` in-flight window depth (default: groups)

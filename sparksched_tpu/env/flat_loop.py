@@ -149,6 +149,47 @@ def write_slot(store, i, ls, drop: bool = False):
     )
 
 
+class TrajRing(struct.PyTreeNode):
+    """Device-resident trajectory ring (ISSUE 18): a [R]-stacked record
+    pytree plus a monotone append cursor, living next to the session
+    store and donated through the record-on serve programs.
+
+    `cursor` counts TOTAL records ever appended (not the wrapped
+    position): the host drains span `[drained, cursor)` and recovers the
+    wrapped indices itself (`i % R`), so an overrun (more than R appends
+    between drains) is detectable as `cursor - drained > R` instead of
+    silently aliasing. `rec` is any [R, ...]-stacked record pytree — the
+    serve layer stacks `RingRec` (serve/aot.py), but the append below is
+    schema-agnostic."""
+
+    cursor: jnp.ndarray  # i32 []; total records appended since init
+    rec: Any  # [R, ...] record pytree
+
+
+def ring_append(ring: TrajRing, recs, mask) -> TrajRing:
+    """Masked in-JIT append into the ring: scalar `mask` appends one
+    record, a [K] `mask` appends the masked subset of [K]-stacked
+    records in order (exclusive-cumsum compaction), both via a single
+    `mode="drop"` scatter — masked-off lanes target index R (out of
+    range) and drop, so the traced program is branch-free and the
+    donated ring updates in place. The wrap (`% R`) happens here, in
+    the compiled program; the cursor advances by the number of records
+    actually appended."""
+    R = jax.tree_util.tree_leaves(ring.rec)[0].shape[0]
+    if jnp.ndim(mask) == 0:
+        n = mask.astype(_i32)
+        idx = jnp.where(mask, ring.cursor % R, R)
+    else:
+        mi = mask.astype(_i32)
+        n = mi.sum()
+        offs = jnp.cumsum(mi) - mi  # exclusive cumsum: append order
+        idx = jnp.where(mask, (ring.cursor + offs) % R, R)
+    rec2 = jax.tree_util.tree_map(
+        lambda s, v: s.at[idx].set(v, mode="drop"), ring.rec, recs
+    )
+    return TrajRing(cursor=ring.cursor + n, rec=rec2)
+
+
 def init_loop_state(state: EnvState) -> LoopState:
     n = state.exec_job.shape[0]
     return LoopState(

@@ -55,6 +55,11 @@ _SCOREBOARD_FIELDS = (
     "replica", "alive", "rps", "p99_ms", "sessions", "hot",
     "inflight", "page_churn_per_s", "quarantine_rate",
     "params_version", "params_lag", "decisions",
+    # ISSUE 18: the device trajectory ring's health — occupancy
+    # (records parked on-device awaiting drain), drains shipped, and
+    # overrun drops (nonzero = the drain cadence can't keep up with
+    # this replica's decision rate)
+    "ring_occ", "ring_drains", "ring_dropped",
 )
 
 
@@ -240,6 +245,9 @@ class FleetCollector:
                            - _stat(stats, "serve_param_version"))
             if stats else None,
             "decisions": _stat(stats, "serve_decisions"),
+            "ring_occ": _stat(stats, "serve_ring_occupancy"),
+            "ring_drains": _stat(stats, "serve_ring_drains"),
+            "ring_dropped": _stat(stats, "serve_ring_dropped"),
             "_window_hist": None,
         }
         if row["alive"]:

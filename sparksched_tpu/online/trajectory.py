@@ -130,6 +130,10 @@ class TrajectoryBuffer:
             "online_dropped_short": 0,
             "online_dropped_quarantined": 0,
             "online_dropped_stale": 0,
+            # ISSUE 18: open episodes dropped because a ring overrun
+            # ate records (a per-session seq gap in a drained chunk) —
+            # a spliced trajectory must never reach the learner
+            "online_dropped_gap": 0,
         }
 
     def __len__(self) -> int:
@@ -190,6 +194,68 @@ class TrajectoryBuffer:
             elif (sid in self._open
                   and len(self._open[sid]["steps"]) >= self.max_steps):
                 self._finish_locked(sid, done=False)  # segment cut
+
+    def ingest_chunk(self, chunk) -> None:
+        """One drained ring chunk (ISSUE 18): a `serve.aot.RingRec`
+        pytree of [n]-stacked host arrays in stream (append) order —
+        the batched replacement for n `add()` calls. Reassembles
+        per-session episodes from the in-ring `(sid, seq,
+        params_version)` stamps, replaying `add()`'s assembly exactly
+        (same step dicts, same python-scalar conversions, same
+        quarantine / done / segment-cut transitions), so ring-drained
+        trajectories are byte-identical to the per-decision path
+        (test-pinned). Only `decided` records enter the ring, and a
+        decided record that ends its episode carries `done` itself,
+        so the per-decision path's not-decided done reports (no-ops
+        on an empty open episode) need no ring counterpart. A
+        per-session `seq` gap — a ring overrun ate records — drops
+        the corrupted open episode (`online_dropped_gap`) and starts
+        fresh rather than splicing across the hole."""
+        import jax
+
+        n = int(np.asarray(chunk.sid).shape[0])
+        if n == 0:
+            return
+        obs_leaves, obs_tdef = jax.tree_util.tree_flatten(chunk.obs)
+        with self._lock:
+            for i in range(n):
+                sid = int(chunk.sid[i])
+                if int(chunk.health_mask[i]):
+                    # poisoned decision: drop the open episode, skip
+                    # the record (add()'s quarantine branch)
+                    self._drop_locked(
+                        sid, "online_dropped_quarantined"
+                    )
+                    continue
+                seq = int(chunk.seq[i])
+                ep = self._open.get(sid)
+                if (ep is not None and "seq" in ep
+                        and seq != ep["seq"] + 1):
+                    self._drop_locked(sid, "online_dropped_gap")
+                    ep = None
+                wall = float(chunk.wall_time[i])
+                if ep is None:
+                    ep = self._open[sid] = {
+                        "t0": wall - float(chunk.dt[i]), "steps": [],
+                    }
+                ep["seq"] = seq
+                ep["steps"].append({
+                    "obs": obs_tdef.unflatten(
+                        [l[i] for l in obs_leaves]
+                    ),
+                    "stage_idx": int(chunk.stage_idx[i]),
+                    "job_idx": int(chunk.job_idx[i]),
+                    "num_exec_k": int(chunk.num_exec[i]) - 1,
+                    "lgprob": float(chunk.lgprob[i]),
+                    "reward": float(chunk.reward[i]),
+                    "wall_time": wall,
+                    "params_version": int(chunk.params_version[i]),
+                })
+                self._count("online_decisions")
+                if bool(chunk.done[i]):
+                    self._finish_locked(sid, done=True)
+                elif len(ep["steps"]) >= self.max_steps:
+                    self._finish_locked(sid, done=False)
 
     def on_close(self, sid: int, quarantined: bool = False) -> None:
         """Session teardown: finalize the partial segment (or drop it,

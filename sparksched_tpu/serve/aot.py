@@ -70,9 +70,11 @@ from flax import struct
 from ..config import EnvParams
 from ..env.flat_loop import (
     LoopState,
+    TrajRing,
     _lane_done,
     apply_and_drain,
     aux_action_fields,
+    ring_append,
     take_slot,
     write_slot,
 )
@@ -112,6 +114,58 @@ class ServeOut(struct.PyTreeNode):
     # (an empty pytree) on record-off programs, so their traced jaxpr
     # is unchanged.
     obs: Any = None
+
+
+class RingRec(struct.PyTreeNode):
+    """One trajectory record as stored in the device ring (ISSUE 18).
+
+    The FULL per-decision record — everything `TrajectoryBuffer.add`
+    reads off a `ServeResult` plus the reassembly stamps — so the ring
+    programs' `ServeOut` can drop its `obs` payload entirely and the
+    host stops materializing records per decision. `sid` (host-assigned
+    session id) and `seq` (the lane's decision count after this
+    decision) let the host reassemble per-session streams from a drain
+    that interleaves sessions; `params_version` stamps which parameter
+    version served the decision (the swap can land mid-ring, so the
+    stamp must ride each record, not the drain)."""
+
+    sid: jnp.ndarray  # i32; host-assigned session id
+    seq: jnp.ndarray  # i32; lane decision count after this decision
+    params_version: jnp.ndarray  # i32; param version that decided
+    stage_idx: jnp.ndarray  # i32; flat padded node index
+    job_idx: jnp.ndarray  # i32
+    num_exec: jnp.ndarray  # i32; 1-based (env convention)
+    lgprob: jnp.ndarray  # f32
+    reward: jnp.ndarray  # f32
+    dt: jnp.ndarray  # f32
+    wall_time: jnp.ndarray  # f32
+    done: jnp.ndarray  # bool; episode over after the drain
+    health_mask: jnp.ndarray  # i32; sentinel bitmask (0 = healthy)
+    obs: Any = None  # the decision's StoredObs record
+
+
+def init_ring(R: int, params: EnvParams, state) -> TrajRing:
+    """A zero-filled [R]-record `TrajRing` matching `state`'s shapes —
+    what the session store allocates per slot group when
+    `record=True, ring=R`. Works on a concrete or abstract `EnvState`
+    (shapes are all that matter)."""
+    from ..trainers.rollout import store_obs
+
+    def rec(st):
+        z = _i32(0)
+        zf = jnp.float32(0.0)
+        return RingRec(
+            sid=z, seq=z, params_version=z, stage_idx=z, job_idx=z,
+            num_exec=z, lgprob=zf, reward=zf, dt=zf, wall_time=zf,
+            done=jnp.bool_(False), health_mask=z,
+            obs=store_obs(observe(params, st), st),
+        )
+
+    shp = jax.eval_shape(rec, state)
+    rec0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((int(R),) + tuple(a.shape), a.dtype), shp
+    )
+    return TrajRing(cursor=_i32(0), rec=rec0)
 
 
 # engine knobs of the serve drain — the round-5 on-chip calibration
@@ -318,16 +372,119 @@ def serve_decide_batch_fn(
     return fn
 
 
-def aot_compile(fn: Callable, *abstract_args, donate_store: bool = True):
+def serve_decide_ring_fn(
+    params: EnvParams,
+    bank: WorkloadBank,
+    policy_fn: Callable,
+    knobs: dict[str, Any] | None = None,
+    shard=None,
+) -> Callable:
+    """The ring-recording single-session program (ISSUE 18):
+    `(store [C], ring, model_params, slot, sid, pver, key, force_stage,
+    force_nexec, use_force) -> (store [C], ring, ServeOut)`.
+    Runs the record-on decision body, but instead of returning the
+    decision's `StoredObs` to the host it appends the full `RingRec`
+    (stamped with the host-passed `sid` and params version `pver`, and
+    the lane's own post-decision count as `seq`) into the donated
+    device ring — the returned `ServeOut` carries `obs=None`, i.e. the
+    same host-visible payload as the record-OFF program, so recording
+    costs the dispatch path nothing. Both `store` and `ring` are meant
+    to be donated at compile time; `sid`/`pver` are ordinary i32
+    runtime arguments (fixed avals — no recompiles as sessions and
+    parameter versions churn)."""
+    base = serve_decide_fn(params, bank, policy_fn, knobs, shard,
+                           record=True)
+
+    def fn(store: LoopState, ring: TrajRing, model_params, slot, sid,
+           pver, key, force_stage, force_nexec, use_force):
+        with annotate("serve/decide_ring"):
+            store2, out = base(store, model_params, slot, key,
+                               force_stage, force_nexec, use_force)
+            rec = RingRec(
+                sid=jnp.asarray(sid, _i32),
+                seq=store2.decisions[slot].astype(_i32),
+                params_version=jnp.asarray(pver, _i32),
+                stage_idx=out.stage_idx,
+                job_idx=out.job_idx,
+                num_exec=out.num_exec,
+                lgprob=out.lgprob,
+                reward=out.reward,
+                dt=out.dt,
+                wall_time=out.wall_time,
+                done=out.done,
+                health_mask=out.health_mask,
+                obs=out.obs,
+            )
+            ring2 = ring_append(ring, rec, out.decided)
+        return store2, ring2, out.replace(obs=None)
+
+    return fn
+
+
+def serve_decide_batch_ring_fn(
+    params: EnvParams,
+    bank: WorkloadBank,
+    batch_policy_fn: Callable,
+    batch: int,
+    knobs: dict[str, Any] | None = None,
+    shard=None,
+) -> Callable:
+    """The ring-recording micro-batched program (ISSUE 18):
+    `(store [C], ring, model_params, slots [K], sids [K], pver, key) ->
+    (store [C], ring, ServeOut-of-[K])`.
+    Record-on decision body, one masked batched ring append (padding
+    and no-decision lanes drop), `ServeOut.obs=None` — the host-visible
+    output matches the record-OFF batch program. `pver` is a scalar:
+    every decision of a batch reads the SAME parameter version (the
+    no-torn-reads contract), so one stamp broadcasts across the
+    batch's ring records."""
+    base = serve_decide_batch_fn(params, bank, batch_policy_fn, batch,
+                                 knobs, shard, record=True)
+
+    def fn(store: LoopState, ring: TrajRing, model_params, slots, sids,
+           pver, key):
+        with annotate("serve/decide_batch_ring"):
+            store2, out = base(store, model_params, slots, key)
+            C = store2.mode.shape[0]
+            idx = jnp.minimum(slots, C - 1)
+            rec = RingRec(
+                sid=sids.astype(_i32),
+                seq=store2.decisions[idx].astype(_i32),
+                params_version=jnp.broadcast_to(
+                    jnp.asarray(pver, _i32), slots.shape
+                ),
+                stage_idx=out.stage_idx,
+                job_idx=out.job_idx,
+                num_exec=out.num_exec,
+                lgprob=out.lgprob,
+                reward=out.reward,
+                dt=out.dt,
+                wall_time=out.wall_time,
+                done=out.done,
+                health_mask=out.health_mask,
+                obs=out.obs,
+            )
+            ring2 = ring_append(ring, rec, out.decided)
+        return store2, ring2, out.replace(obs=None)
+
+    return fn
+
+
+def aot_compile(fn: Callable, *abstract_args, donate_store: bool = True,
+                donate_ring: bool = False):
     """`jax.jit(fn).lower(...).compile()` with the store (arg 0)
     donated. Returns `(compiled, secs)` — the compile wall time is the
     cold-start figure the latency bench records. The compiled
     executable bypasses the jit dispatch cache entirely: no tracing,
-    no cache lookup, no recompile can happen on the warm path."""
+    no cache lookup, no recompile can happen on the warm path. With
+    `donate_ring` (the ring programs, ISSUE 18) argument 1 — the
+    trajectory ring — is donated too, so the in-JIT append updates the
+    ring in place."""
     t0 = time.perf_counter()
-    jitted = jax.jit(
-        fn, donate_argnums=(0,) if donate_store else ()
-    )
+    dn = (0,) if donate_store else ()
+    if donate_ring:
+        dn = dn + (1,)
+    jitted = jax.jit(fn, donate_argnums=dn)
     compiled = jitted.lower(*abstract_args).compile()
     return compiled, time.perf_counter() - t0
 
@@ -371,6 +528,11 @@ SERVE_AUDIT_BATCH = 4
 # buffer widths change), and the registry pin proves it stays that
 # way — grouping adds zero equations, zero gathers, zero scatters.
 SERVE_AUDIT_GROUPS = 2
+# ISSUE 18: audit ring depth for the ring-variant record programs. Like
+# capacity/batch it only scales buffer widths (the append is one masked
+# scatter regardless of R), so a small ring keeps the audit cheap while
+# the eqn/gather/scatter pins stay representative.
+SERVE_AUDIT_RING = 16
 
 
 def serve_callables(
@@ -478,5 +640,31 @@ def serve_callables(
                 params, bank, bpol, batch, record=True
             ),
             (store, mp, slots, key),
+        ),
+        # ISSUE 18: the ring-recording variants (`SessionStore(
+        # record=True, ring=R)`) — the record body plus ONE masked ring
+        # append. Budgeted separately so the append's scatter cost is
+        # visible and capped, while the record-off AND plain record-on
+        # pins above prove both existing paths are structurally
+        # untouched by the ring machinery.
+        "serve_decide_record_ring": (
+            serve_decide_ring_fn(params, bank, pol),
+            (
+                store,
+                jax.eval_shape(
+                    lambda: init_ring(SERVE_AUDIT_RING, params, state)
+                ),
+                mp, i32, i32, i32, key, i32, i32, b,
+            ),
+        ),
+        "serve_decide_batch_record_ring": (
+            serve_decide_batch_ring_fn(params, bank, bpol, batch),
+            (
+                store,
+                jax.eval_shape(
+                    lambda: init_ring(SERVE_AUDIT_RING, params, state)
+                ),
+                mp, slots, slots, i32, key,
+            ),
         ),
     }

@@ -51,6 +51,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+import numpy as np
+
 from ..obs.runlog import emit
 from .session import (
     RemoteResult,
@@ -169,6 +171,14 @@ def _replica_main(conn, idx: int, spec: ReplicaSpec) -> None:
         front = front_from_config(
             cfg, store, metrics=registry, trace=spec.trace,
         )
+        # ISSUE 18: a ring-on replica parks drained trajectory chunks
+        # (already host numpy, in stream order) in this outbox instead
+        # of a local collector; the router's `ring_pump` fetches the
+        # whole backlog in ONE `ring_chunks` round-trip — the batched
+        # wire feed that replaces per-decision RPCs to the learner
+        ring_out: list[tuple] = []
+        if getattr(store, "_ring_on", False):
+            store.ring_sink = ring_out.append
         conn.send(("ready", idx, {
             "capacity": store.capacity, "pid": os.getpid(),
             "front": front.front_name,
@@ -217,9 +227,21 @@ def _replica_main(conn, idx: int, spec: ReplicaSpec) -> None:
                     elif op == "poison":
                         _poison_session(store, msg[2])
                         reply(rid, {"poisoned": msg[2]})
+                    elif op == "ring_chunks":
+                        # msg[2] (force) drains the device rings to
+                        # the outbox first; otherwise ship whatever
+                        # the normal triggers (cadence / harvest-idle
+                        # / close / swap) already landed there
+                        if msg[2]:
+                            store.drain_ring(wait=True)
+                        ents = list(ring_out)
+                        ring_out.clear()
+                        reply(rid, ents)
                     elif op == "stop":
                         stop = True
                         front.flush()
+                        if getattr(store, "_ring_on", False):
+                            store.drain_ring(wait=True)
                         reply(rid, {"stopped": idx})
                     else:
                         reply_err(rid, ValueError(
@@ -286,7 +308,8 @@ class Router:
     fleet, if any replica fails to boot)."""
 
     def __init__(self, spec: ReplicaSpec, replicas: int = 2, *,
-                 metrics=None, runlog=None,
+                 metrics=None, runlog=None, collector=None,
+                 ring_period_s: float = 0.25,
                  start_timeout_s: float = 300.0) -> None:
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
@@ -294,6 +317,14 @@ class Router:
         self.n = int(replicas)
         self.metrics = metrics
         self.runlog = runlog
+        # ISSUE 18: the fleet-level trajectory sink (a
+        # `TrajectoryBuffer`, duck-typed `ingest_chunk`/`on_close`).
+        # Every replica's ring chunks land here with session ids
+        # remapped to the global space, so one learner feeds off the
+        # whole fleet without per-decision RPCs.
+        self.collector = collector
+        self.ring_period_s = float(ring_period_s)
+        self._ring_next = 0.0
         self.front_name = f"router{self.n}"
         self.params_version = 0
         self.stats: dict[str, int] = {
@@ -657,6 +688,53 @@ class Router:
             out.append(sample)
         return out
 
+    # -- the fleet trajectory feed (ISSUE 18) ------------------------------
+
+    def ring_pump(self, force: bool = False) -> int:
+        """Fetch every live replica's accumulated ring chunks in ONE
+        `ring_chunks` round-trip per replica and feed the fleet-level
+        `collector`, remapping each chunk's whole `sid` array (and
+        every close event) from the replica's local ids to the global
+        space in one vectorized step — `gsid = lsid * n + idx`, the
+        affinity map. `force=True` makes each replica drain its
+        device rings first (the teardown / end-of-window path).
+        Returns the number of records ingested. No-op without a
+        collector."""
+        if self.collector is None:
+            return 0
+        moved = 0
+        for r in self._alive():
+            try:
+                ents = self._call(r, ("ring_chunks", bool(force)))
+            except (ReplicaDied, RuntimeError):
+                continue
+            for ent in ents:
+                if ent[0] == "chunk":
+                    chunk = ent[1]
+                    lsid = np.asarray(chunk.sid)
+                    moved += int(lsid.shape[0])
+                    self.collector.ingest_chunk(chunk.replace(
+                        sid=(lsid * self.n + r.idx).astype(lsid.dtype)
+                    ))
+                else:  # ("close", lsid, quarantined)
+                    self.collector.on_close(
+                        int(ent[1]) * self.n + r.idx,
+                        quarantined=bool(ent[2]),
+                    )
+        return moved
+
+    def _maybe_ring_pump(self) -> None:
+        """The `poll()`-cadence half: one fleet sweep per
+        `ring_period_s`, so the pump loop that already drives the
+        pipes ships trajectories too — no extra thread, no
+        per-decision traffic."""
+        if self.collector is None:
+            return
+        now = time.monotonic()
+        if now >= self._ring_next:
+            self._ring_next = now + self.ring_period_s
+            self.ring_pump()
+
     # -- batching-front facade ---------------------------------------------
 
     def submit(self, gsid: int) -> RouterTicket:
@@ -691,7 +769,9 @@ class Router:
         return len(self._tickets)
 
     def poll(self) -> bool:
-        return self._drain()
+        moved = self._drain()
+        self._maybe_ring_pump()
+        return moved
 
     def flush(self, timeout_s: float = 120.0) -> None:
         deadline = time.monotonic() + timeout_s
@@ -712,6 +792,11 @@ class Router:
         if self._stopped:
             return
         self._stopped = True
+        if self.collector is not None:
+            try:  # last full sweep: no trajectory stranded in a ring
+                self.ring_pump(force=True)
+            except RuntimeError:
+                pass
         for r in self._replicas:
             if r.dead or not r.proc.is_alive():
                 continue

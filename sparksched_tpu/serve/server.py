@@ -254,6 +254,15 @@ class ServeServer:
                 self.front.poll()
                 if self.collector is not None:
                     self.collector.maybe_scrape()
+                # ISSUE 18: behind a fleet, the trajectory-ring feed
+                # rides this pump thread too (`Router.ring_pump`,
+                # throttled) — batched replica->learner chunk
+                # shipping, same single-owner discipline as the
+                # collector scrape above
+                ring_pump = getattr(
+                    self.store, "_maybe_ring_pump", None)
+                if ring_pump is not None:
+                    ring_pump()
             except Exception:  # keep pumping: one bad poll must not
                 self._count("serve_http_errors")  # strand handlers
                 time.sleep(0.01)

@@ -159,8 +159,11 @@ from .aot import (
     SERVE_KNOBS,
     abstract_like,
     aot_compile,
+    init_ring,
     serve_decide_batch_fn,
+    serve_decide_batch_ring_fn,
     serve_decide_fn,
+    serve_decide_ring_fn,
 )
 
 _i32 = jnp.int32
@@ -351,6 +354,8 @@ class SessionStore:
         metrics=None,
         trace: bool = False,
         record: bool = False,
+        ring: int = 0,
+        ring_drain: int | None = None,
         collector=None,
     ) -> None:
         hot = int(capacity if hot_capacity is None else hot_capacity)
@@ -407,6 +412,54 @@ class SessionStore:
         # .add(result) / .on_close(sid, quarantined=...))
         self.record = bool(record)
         self.collector = collector
+        # ISSUE 18: the device-resident trajectory ring. `ring=R > 0`
+        # (record-on stores only) compiles the RING-recording programs:
+        # decisions append their full record into a per-group donated
+        # [R]-record device ring instead of returning per-decision
+        # StoredObs payloads to the host, and the host drains the ring
+        # in ONE batched transfer every `ring_drain` decisions (or at
+        # harvest-idle / close / param-swap boundaries), chained behind
+        # in-flight calls like the non-blocking pager. `ring=0` keeps
+        # the per-decision record path (the A/B partner).
+        self.ring_size = int(ring)
+        if self.ring_size < 0:
+            raise ValueError(f"ring={ring} must be >= 0")
+        if self.ring_size and not self.record:
+            raise ValueError(
+                "ring > 0 requires record=True (the ring IS the "
+                "record path — a ring without recording would compile "
+                "dead append machinery)"
+            )
+        if self.ring_size and self.ring_size < self.max_batch:
+            raise ValueError(
+                f"ring={ring} must be >= max_batch={max_batch} (one "
+                "compiled call can append up to max_batch records; a "
+                "smaller ring would drop records within a single call)"
+            )
+        self._ring_on = self.record and self.ring_size > 0
+        if ring_drain is not None and not self._ring_on:
+            raise ValueError(
+                "ring_drain requires ring > 0 (there is no ring to "
+                "set a drain cadence for)"
+            )
+        # default cadence: half the ring, clamped so a worst-case
+        # burst between snapshots (`ring_drain - 1` potential appends
+        # plus one full batch dispatched before the trigger re-checks)
+        # still fits the ring — the default can never overrun; an
+        # EXPLICIT tighter-than-safe cadence is allowed (overruns are
+        # counted, `serve_ring_dropped`, and the buffer's seq-gap
+        # guard drops spliced episodes)
+        self.ring_drain = (
+            max(1, min(self.ring_size // 2,
+                       self.ring_size - self.max_batch + 1))
+            if ring_drain is None else int(ring_drain)
+        )
+        if self._ring_on and not 1 <= self.ring_drain <= self.ring_size:
+            raise ValueError(
+                f"ring_drain={ring_drain} must be in [1, ring="
+                f"{self.ring_size}] (a cadence past the ring depth "
+                "guarantees overwritten records)"
+            )
 
         pol, bpol = scheduler.serve_param_policies(
             deterministic=deterministic
@@ -468,13 +521,41 @@ class SessionStore:
                 g = jax.device_put(g, shard)
             stores.append(g)
 
+        # ISSUE 18: per-group device rings (ring mode only), plus the
+        # non-donating compiled ring COPY the drain snapshots through —
+        # its input is the latest dispatched call's ring output, so the
+        # copy chains behind every in-flight call instead of syncing on
+        # them (the non-blocking pager's discipline).
+        ring0 = None
+        self._rings: list[Any] = []
+        if self._ring_on:
+            ring0 = init_ring(self.ring_size, params, ls0.env)
+            self._rings = [
+                jax.tree_util.tree_map(jnp.copy, ring0)
+                for _ in range(self.groups)
+            ]
+            self._ring_take = jax.jit(
+                lambda r: jax.tree_util.tree_map(jnp.copy, r)
+            )
+        # potential undrained appends per group (counted at dispatch —
+        # an upper bound on ring occupancy, so the cadence trigger can
+        # only over-drain, never under-drain), total records already
+        # ingested per group (the host cursor), and the per-group FIFO
+        # of pending drain snapshots + deferred close events
+        self._ring_pot = [0] * self.groups
+        self._ring_drained = [0] * self.groups
+        self._ring_pending: list[deque] = [
+            deque() for _ in range(self.groups)
+        ]
+        # optional chunk sink (serve/server.py sets it): drained ring
+        # chunks and close events go here instead of the collector, to
+        # cross a process/socket boundary in batches
+        self.ring_sink = None
+        # muted during the constructor's warmup calls (their dummy
+        # appends are discarded with the warmup ring below)
+        self._ring_mute = True
+
         # ---- AOT lowering + compile (the cold start) ----
-        fn1 = serve_decide_fn(params, bank, pol, self.knobs,
-                              shard=shard, record=self.record)
-        fnk = serve_decide_batch_fn(
-            params, bank, bpol, self.max_batch, self.knobs,
-            shard=shard, record=self.record,
-        )
         st_abs = abstract_like(stores[0], keep_sharding=shard is not None)
         mp_abs = abstract_like(
             self._model_params, keep_sharding=mesh is not None
@@ -483,13 +564,36 @@ class SessionStore:
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         b = jax.ShapeDtypeStruct((), jnp.bool_)
         slots = jax.ShapeDtypeStruct((self.max_batch,), jnp.int32)
-        self._c1, secs1 = aot_compile(
-            fn1, st_abs, mp_abs, i32, key, i32, i32, b,
-            donate_store=donate,
-        )
-        self._ck, secsk = aot_compile(
-            fnk, st_abs, mp_abs, slots, key, donate_store=donate
-        )
+        if self._ring_on:
+            fn1 = serve_decide_ring_fn(params, bank, pol, self.knobs,
+                                       shard=shard)
+            fnk = serve_decide_batch_ring_fn(
+                params, bank, bpol, self.max_batch, self.knobs,
+                shard=shard,
+            )
+            rg_abs = abstract_like(ring0)
+            self._c1, secs1 = aot_compile(
+                fn1, st_abs, rg_abs, mp_abs, i32, i32, i32, key,
+                i32, i32, b, donate_store=donate, donate_ring=donate,
+            )
+            self._ck, secsk = aot_compile(
+                fnk, st_abs, rg_abs, mp_abs, slots, slots, i32, key,
+                donate_store=donate, donate_ring=donate,
+            )
+        else:
+            fn1 = serve_decide_fn(params, bank, pol, self.knobs,
+                                  shard=shard, record=self.record)
+            fnk = serve_decide_batch_fn(
+                params, bank, bpol, self.max_batch, self.knobs,
+                shard=shard, record=self.record,
+            )
+            self._c1, secs1 = aot_compile(
+                fn1, st_abs, mp_abs, i32, key, i32, i32, b,
+                donate_store=donate,
+            )
+            self._ck, secsk = aot_compile(
+                fnk, st_abs, mp_abs, slots, key, donate_store=donate
+            )
         self.compile_secs = {"decide": secs1, "decide_batch": secsk}
 
         # host-side session/slot bookkeeping: sids are public handles,
@@ -557,6 +661,15 @@ class SessionStore:
             "serve_param_version": 0,
             "serve_inflight_peak": 0,
             "serve_prefetches": 0,
+            # ISSUE 18: ring telemetry — current potential occupancy
+            # (records appended since the last drain snapshot), drain
+            # snapshots taken, records ingested, and records LOST to a
+            # ring overrun (cursor advanced past depth between drains;
+            # the exact count, recovered from the snapshot's cursor)
+            "serve_ring_occupancy": 0,
+            "serve_ring_drains": 0,
+            "serve_ring_records": 0,
+            "serve_ring_dropped": 0,
         }
 
         # ---- warmup: one call per program, so the warm path never
@@ -577,6 +690,12 @@ class SessionStore:
         self.warmup_secs = time.perf_counter() - t0
         # reset warmup's mutation of slot 0 back to a clean dummy
         self._stores[0] = self._write_slot(self._stores[0], _i32(0), ls0)
+        if self._ring_on:
+            # warmup's dummy decision may have appended a bogus record
+            # (no live session yet): restart group 0 on a fresh ring
+            self._rings[0] = jax.tree_util.tree_map(jnp.copy, ring0)
+            self._ring_pot = [0] * self.groups
+        self._ring_mute = False
 
         # the optional background harvester (ISSUE 15, `harvester:`
         # config key): materializes the oldest in-flight call's device
@@ -617,17 +736,52 @@ class SessionStore:
         self._calls += 1
         return jax.random.fold_in(self._base_key, self._calls)
 
-    def _call1(self, group, local, fstage, fnexec, use_force):
+    def _call1(self, group, local, fstage, fnexec, use_force, sid=-1):
+        if self._ring_on:
+            store2, self._rings[group], out = self._c1(
+                self._stores[group], self._rings[group],
+                self._model_params, local, _i32(sid),
+                _i32(self.params_version), self._next_key(),
+                fstage, fnexec, use_force,
+            )
+            self._ring_dispatched(group, 1)
+            return store2, out
         return self._c1(
             self._stores[group], self._model_params, local,
             self._next_key(), fstage, fnexec, use_force,
         )
 
-    def _callk(self, group, locals_):
+    def _callk(self, group, locals_, sids=None):
+        if self._ring_on:
+            sv = np.full(self.max_batch, -1, np.int32)
+            if sids is not None:
+                sv[: len(sids)] = sids
+            store2, self._rings[group], out = self._ck(
+                self._stores[group], self._rings[group],
+                self._model_params, locals_, jnp.asarray(sv),
+                _i32(self.params_version), self._next_key(),
+            )
+            self._ring_dispatched(
+                group, self.max_batch if sids is None else len(sids)
+            )
+            return store2, out
         return self._ck(
             self._stores[group], self._model_params, locals_,
             self._next_key(),
         )
+
+    def _ring_dispatched(self, group: int, n: int) -> None:
+        """Count a dispatched call's potential ring appends and
+        schedule a drain snapshot once the cadence is reached. The
+        count is an UPPER bound (no-decision lanes don't append), so
+        the trigger can only over-drain — an actual overrun is still
+        detected exactly from the snapshot's cursor."""
+        if self._ring_mute:
+            return
+        self._ring_pot[group] += int(n)
+        self.stats["serve_ring_occupancy"] = sum(self._ring_pot)
+        if self._ring_pot[group] >= self.ring_drain:
+            self._ring_snapshot(group)
 
     def _served(self, group, call):
         """Run one compiled serve call SYNCHRONOUSLY and hand back
@@ -664,6 +818,7 @@ class SessionStore:
             # page-out write-backs whose device work finished, so
             # deferred gathers never accumulate HBM across a window
             self._drain_writebacks()
+            self._drain_ring_writebacks()
             return out
         t_dispatch = time.perf_counter()
         self._stores[group], out = call()
@@ -675,6 +830,7 @@ class SessionStore:
         self.wall_split["dispatch_s"] += t_harvest - t_dispatch
         self.wall_split["blocked_host_s"] += t_scatter - t_harvest
         self._drain_writebacks()
+        self._drain_ring_writebacks()
         self.last_spans = {
             "dispatch": t_dispatch,
             "harvest": t_harvest,
@@ -746,6 +902,105 @@ class SessionStore:
             else:
                 remaining.append(sid)
         self._wb_pending = remaining
+
+    # -- the trajectory ring drain (ISSUE 18) ------------------------------
+
+    def _ring_snapshot(self, group: int) -> None:
+        """Schedule a NON-BLOCKING drain of one group's ring: a
+        compiled non-donating copy of the whole ring whose input is
+        the latest dispatched call's ring output — it chains behind
+        every in-flight call on the group (data dependency) instead of
+        syncing on them, and the host materialization is deferred to
+        `_drain_ring_writebacks` (the pager's write-back discipline,
+        applied to trajectories)."""
+        snap = self._ring_take(self._rings[group])
+        self._ring_pending[group].append(("snap", snap))
+        self._ring_pot[group] = 0
+        self.stats["serve_ring_occupancy"] = sum(self._ring_pot)
+        self.stats["serve_ring_drains"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("serve_ring_drains")
+
+    def _ring_emit_close(self, sid: int, quarantined: bool) -> None:
+        """Route one session-close event to the chunk sink (the wire
+        path) or the local collector — always AFTER every ring record
+        of the session has been ingested (the per-group FIFO keeps
+        chunks and close events in stream order)."""
+        if self.ring_sink is not None:
+            self.ring_sink(("close", int(sid), bool(quarantined)))
+        elif self.collector is not None:
+            self.collector.on_close(sid, quarantined=quarantined)
+
+    def _ring_ingest(self, group: int, snap) -> None:
+        """Consume one materialized drain snapshot: the exact
+        undrained span is `[drained, cursor)` read from the SNAPSHOT's
+        own cursor (not a host guess), an overrun past the ring depth
+        is counted as dropped records (the oldest are gone), and the
+        surviving records — already host numpy — are sliced into ONE
+        in-order chunk for the sink/collector."""
+        end = int(snap.cursor)
+        start = self._ring_drained[group]
+        if end <= start:
+            return
+        dropped = (end - start) - self.ring_size
+        if dropped > 0:
+            self.stats["serve_ring_dropped"] += dropped
+            if self.metrics is not None:
+                self.metrics.counter("serve_ring_dropped", dropped)
+            start += dropped
+        idx = np.arange(start, end) % self.ring_size
+        chunk = jax.tree_util.tree_map(lambda a: a[idx], snap.rec)
+        self._ring_drained[group] = end
+        self.stats["serve_ring_records"] += end - start
+        if self.ring_sink is not None:
+            self.ring_sink(("chunk", chunk))
+        elif self.collector is not None:
+            self.collector.ingest_chunk(chunk)
+
+    def _drain_ring_writebacks(self, wait: bool = False) -> None:
+        """Process each group's pending drain queue in order: a
+        snapshot whose device copy finished (or `wait=True`) is
+        materialized in ONE batched transfer and ingested; a deferred
+        close event fires once every chunk queued before it has been
+        ingested. With `wait=False` nothing blocks (the pump path's
+        contract); a not-yet-ready snapshot stalls ITS group's queue
+        only."""
+        if not self._ring_on:
+            return
+        for g in range(self.groups):
+            pend = self._ring_pending[g]
+            while pend:
+                entry = pend[0]
+                if entry[0] == "close":
+                    pend.popleft()
+                    self._ring_emit_close(entry[1], entry[2])
+                    continue
+                snap = entry[1]
+                ready = all(
+                    l.is_ready()
+                    for l in jax.tree_util.tree_leaves(snap)
+                    if hasattr(l, "is_ready")
+                )
+                if not (ready or wait):
+                    break
+                pend.popleft()
+                self._ring_ingest(
+                    g, jax.tree_util.tree_map(np.asarray, snap)
+                )
+
+    def drain_ring(self, wait: bool = True) -> None:
+        """Force a ring drain: snapshot every group with potential
+        undrained records, then process the pending queues —
+        `wait=True` (teardown / end-of-window / parity checks) blocks
+        until every record reached the sink; `wait=False` (the
+        param-swap boundary) only schedules and ingests what is
+        already ready. No-op on a ring-off store."""
+        if not self._ring_on:
+            return
+        for g in range(self.groups):
+            if self._ring_pot[g] > 0:
+                self._ring_snapshot(g)
+        self._drain_ring_writebacks(wait=wait)
 
     def _alloc_slot(self, group: int, pinned: set[int]) -> int:
         """A free device slot in `group`, evicting within the group if
@@ -878,6 +1133,14 @@ class SessionStore:
             aval_bytes(jax.ShapeDtypeStruct(l.shape, l.dtype))
             for l in jax.tree_util.tree_leaves(self.bank)
         )
+        # ISSUE 18: the per-group trajectory rings are device-resident
+        # fixed cost too — a hot-set prediction that ignored them
+        # would over-admit slots on a ring-recording store
+        for rg in self._rings:
+            fixed += sum(
+                aval_bytes(jax.ShapeDtypeStruct(l.shape, l.dtype))
+                for l in jax.tree_util.tree_leaves(rg)
+            )
         return hot_set_fit(
             slot, candidates=candidates,
             budget_bytes=(
@@ -966,6 +1229,11 @@ class SessionStore:
                 self.params_version, prev_version=prev_version,
                 action=origin, reason=reason,
             )
+        # ISSUE 18: a param swap is a ring-drain boundary — records
+        # stamped with the outgoing version reach the learner promptly
+        # (its staleness guard runs on version lag), without blocking
+        # the dispatch path
+        self.drain_ring(wait=False)
         return self.params_version
 
     def rollback_params(self, reason: str | None = None) -> int:
@@ -990,6 +1258,7 @@ class SessionStore:
                 self.params_version, prev_version=prev_version,
                 action="rollback", reason=reason,
             )
+        self.drain_ring(wait=False)  # swap boundary (see set_params)
         return self.params_version
 
     # -- session lifecycle -------------------------------------------------
@@ -1038,12 +1307,30 @@ class SessionStore:
 
     def close(self, sid: int) -> None:
         self._check_sid(sid, allow_quarantined=True)
-        if self.collector is not None:
+        if self.collector is not None or (
+            self._ring_on and self.ring_sink is not None
+        ):
             # finalize (or drop, when quarantined) the session's open
             # trajectory before the sid is reused by a fresh episode
-            self.collector.on_close(
-                sid, quarantined=bool(self._quarantined[sid])
-            )
+            quar = bool(self._quarantined[sid])
+            if self._ring_on:
+                # ring mode (ISSUE 18): every record of the session
+                # must reach the collector BEFORE its close event.
+                # Snapshot the session's group now (non-blocking —
+                # the copy chains behind in-flight calls) and defer
+                # the close event into the same FIFO, so order is
+                # preserved without syncing the dispatch path.
+                g = self.session_group(sid)
+                if self._ring_pot[g] > 0:
+                    self._ring_snapshot(g)
+                if self._ring_pending[g]:
+                    self._ring_pending[g].append(("close", sid, quar))
+                    self._drain_ring_writebacks()
+                else:
+                    # nothing undrained: fire in order, immediately
+                    self._ring_emit_close(sid, quar)
+            else:
+                self.collector.on_close(sid, quarantined=quar)
         slot = int(self._slot_of[sid])
         if slot >= 0:
             self._sid_of[slot] = -1
@@ -1089,8 +1376,12 @@ class SessionStore:
         """Feed one served decision to the trajectory collector (the
         online actor path, ISSUE 14). The collector owns episode
         assembly and eviction; a quarantining decision still reaches
-        it (the collector drops the poisoned episode itself)."""
-        if self.collector is not None:
+        it (the collector drops the poisoned episode itself). RING
+        mode (ISSUE 18) skips this entirely: the record already lives
+        in the device ring and reaches the collector via the batched
+        drain (`ingest_chunk`) — this per-decision host hop is exactly
+        the cost the ring removes."""
+        if self.collector is not None and not self._ring_on:
             self.collector.add(res)
 
     def _batch_group(self, sids: list[int]) -> int:
@@ -1113,7 +1404,7 @@ class SessionStore:
         g, l = divmod(slot, self.group_slots)
         ver = self.params_version  # staleness stamp: live at dispatch
         out = self._served(g, lambda: self._call1(
-            g, _i32(l), _i32(-1), _i32(0), jnp.bool_(False)
+            g, _i32(l), _i32(-1), _i32(0), jnp.bool_(False), sid=sid
         ))
         res = ServeResult(sid, out, None, batched=False,
                           params_version=ver, obs=out.obs)
@@ -1132,7 +1423,7 @@ class SessionStore:
         ver = self.params_version
         out = self._served(g, lambda: self._call1(
             g, _i32(l), _i32(stage_idx), _i32(num_exec),
-            jnp.bool_(True),
+            jnp.bool_(True), sid=sid,
         ))
         res = ServeResult(sid, out, None, batched=False,
                           params_version=ver, obs=out.obs)
@@ -1197,7 +1488,8 @@ class SessionStore:
         ]
         ver = self.params_version
         out = self._served(
-            group, lambda: self._callk(group, jnp.asarray(slots))
+            group,
+            lambda: self._callk(group, jnp.asarray(slots), sids=sids),
         )
         return self._batch_results(sids, out, ver)
 
@@ -1240,7 +1532,8 @@ class SessionStore:
             # under identical admission order)
             l = batch_slots[0] % self.group_slots
             self._stores[group], out = self._call1(
-                group, _i32(l), _i32(-1), _i32(0), jnp.bool_(False)
+                group, _i32(l), _i32(-1), _i32(0), jnp.bool_(False),
+                sid=sids[0],
             )
             batched = False
         else:
@@ -1249,7 +1542,7 @@ class SessionStore:
                 s % self.group_slots for s in batch_slots
             ]
             self._stores[group], out = self._callk(
-                group, jnp.asarray(slots)
+                group, jnp.asarray(slots), sids=sids
             )
             batched = True
         t1 = time.perf_counter()
@@ -1355,6 +1648,7 @@ class SessionStore:
             self.stats["serve_decisions"] += 1
             call.results = [res]
         self._drain_writebacks()
+        self._drain_ring_writebacks()
         return call.results
 
     def harvest(self, wait: bool = True, limit: int | None = None
@@ -1370,7 +1664,15 @@ class SessionStore:
         done = self.pop_ready(wait=wait, limit=limit)
         for call in done:
             self.finalize_call(call)
-        self._drain_writebacks(wait=wait and not self._inflight)
+        idle = wait and not self._inflight
+        self._drain_writebacks(wait=idle)
+        # harvest-idle is a ring-drain boundary (ISSUE 18): with the
+        # in-flight window empty there is no dispatch to protect, so
+        # leftover records (a partial cadence) flush to the collector
+        if idle:
+            self.drain_ring(wait=True)
+        else:
+            self._drain_ring_writebacks()
         return done
 
     def _harvester_loop(self) -> None:
@@ -2109,6 +2411,10 @@ def store_from_config(
         # ISSUE 14: compile the record-on serve programs (per-decision
         # StoredObs records — the online trajectory path's payload)
         "record": bool(cfg.get("record", False)),
+        # ISSUE 18: the device-resident trajectory ring (record-on
+        # stores only; ring=0 keeps the per-decision record path) and
+        # its drain cadence (defaults to ring // 2 in the store)
+        "ring": int(cfg.get("ring", 0)),
         # ISSUE 15: independently-donated slot groups (the in-flight
         # window's width) + the optional background harvester thread
         "groups": int(cfg.get("groups", 1)),
@@ -2116,6 +2422,8 @@ def store_from_config(
     }
     # ISSUE 13: the pager (device slots < sessions) and the dp-sharded
     # store; both default off so an r11 block builds an r11 store
+    if cfg.get("ring_drain") is not None:
+        kw["ring_drain"] = int(cfg["ring_drain"])
     if cfg.get("hot_capacity") is not None:
         kw["hot_capacity"] = int(cfg["hot_capacity"])
     if cfg.get("shard_dp"):

@@ -510,13 +510,16 @@ def test_ledger_cli_full_coverage_and_round_pins():
     """The gate the issue pins: `python -m sparksched_tpu.obs.ledger`
     over the repo's own artifacts/ + BENCH_*.json indexes EVERY file
     and holds the round-scoped headline rows (125 rps@SLO in r17, the
-    47.27 rps loopback fleet row in r18). rc must be 0 — coverage
-    failures (2), pin drift (3), and un-waived regressions (4) all
-    break tier-1 by design."""
+    47.27 rps loopback fleet row in r18, and ISSUE 18's ring-drained
+    record path: blocked_host_wall per call with record ON, 0.1466 ms
+    at r20 — within noise of the 0.1381 record-off floor). rc must be
+    0 — coverage failures (2), pin drift (3), and un-waived
+    regressions (4) all break tier-1 by design."""
     proc = subprocess.run(
         [sys.executable, "-m", "sparksched_tpu.obs.ledger",
          "--pin", "sustained_rps_slo_continuous@r17=125.0",
-         "--pin", "serve_scale_net50rps_loopback@r18=47.27"],
+         "--pin", "serve_scale_net50rps_loopback@r18=47.27",
+         "--pin", "blocked_host_wall_record_on@r20=0.1466"],
         cwd=REPO, capture_output=True, text=True,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )

@@ -1,0 +1,503 @@
+"""The device-resident trajectory ring (ISSUE 18): the `ring_append`
+device primitive (masked batch append, wrap, drop-lane scatter), the
+store's ring config surface, `TrajectoryBuffer.ingest_chunk`'s exact
+replay of the per-decision `add()` assembly, and — slow tier — the
+bit-parity pin of ring-drained trajectories against the per-decision
+record path on a REAL two-group store (ring wrap, group boundaries,
+mid-stream quarantine eviction, mid-ring param swap), the overrun
+accounting (tight explicit cadence -> counted drops + seq-gap
+episode eviction, never a spliced trajectory), and the fleet feed:
+two spawned replicas streaming ring chunks through the router into
+ONE learner that publishes a finite-loss update fleet-wide.
+
+The expensive pieces (AOT store compiles, spawned replica processes)
+are slow-marked like the router tests in tests/test_serve_net.py;
+tier-1 keeps the pure-host/pure-trace units.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparksched_tpu.config import SERVE_KEYS
+from sparksched_tpu.env.flat_loop import TrajRing, ring_append
+from sparksched_tpu.online import TrajectoryBuffer
+from sparksched_tpu.serve import SessionStore
+from sparksched_tpu.serve.aot import RingRec
+from tests.test_serve_net import fleet_builder
+
+AGENT_CFG = {
+    "agent_cls": "DecimaScheduler",
+    "embed_dim": 8,
+    "gnn_mlp_kwargs": {"hid_dims": [16]},
+    "policy_mlp_kwargs": {"hid_dims": [16]},
+    "job_bucket": 4,
+}
+
+
+# ---------------------------------------------------------------------------
+# the device primitive
+# ---------------------------------------------------------------------------
+
+
+def _tiny_ring(R: int) -> TrajRing:
+    return TrajRing(
+        cursor=jnp.int32(0),
+        rec={
+            "a": jnp.zeros((R,), jnp.int32),
+            "b": jnp.zeros((R, 2), jnp.float32),
+        },
+    )
+
+
+def test_ring_append_scalar_mask_and_wrap():
+    ring = _tiny_ring(3)
+    for k in range(5):
+        rec = {
+            "a": jnp.int32(k + 1),
+            "b": jnp.full((2,), float(k + 1), jnp.float32),
+        }
+        ring = ring_append(ring, rec, jnp.bool_(True))
+    assert int(ring.cursor) == 5
+    # wrap: positions hold the LAST write at each slot (4->r1, 5->r2,
+    # 3 survives at r0 from the second lap)
+    np.testing.assert_array_equal(np.asarray(ring.rec["a"]), [4, 5, 3])
+    # a masked-off append moves nothing
+    ring2 = ring_append(
+        ring, {"a": jnp.int32(99),
+               "b": jnp.zeros((2,), jnp.float32)},
+        jnp.bool_(False),
+    )
+    assert int(ring2.cursor) == 5
+    np.testing.assert_array_equal(
+        np.asarray(ring2.rec["a"]), np.asarray(ring.rec["a"])
+    )
+
+
+def test_ring_append_batch_mask_compacts_in_lane_order():
+    ring = _tiny_ring(8)
+    recs = {
+        "a": jnp.asarray([10, 20, 30, 40], jnp.int32),
+        "b": jnp.zeros((4, 2), jnp.float32),
+    }
+    mask = jnp.asarray([True, False, True, True])
+    ring = ring_append(ring, recs, mask)
+    # only decided lanes append, COMPACTED in lane order (exclusive
+    # cumsum offsets — the stream order the host reassembly relies on)
+    assert int(ring.cursor) == 3
+    np.testing.assert_array_equal(
+        np.asarray(ring.rec["a"])[:3], [10, 30, 40]
+    )
+    # and the batch append wraps too
+    ring = ring_append(
+        ring,
+        {"a": jnp.asarray([50, 60, 70, 80], jnp.int32),
+         "b": jnp.zeros((4, 2), jnp.float32)},
+        jnp.asarray([True, True, True, True]),
+    )
+    ring = ring_append(
+        ring,
+        {"a": jnp.asarray([90, 91, 92, 93], jnp.int32),
+         "b": jnp.zeros((4, 2), jnp.float32)},
+        jnp.asarray([True, True, False, False]),
+    )
+    assert int(ring.cursor) == 9
+    order = [
+        int(np.asarray(ring.rec["a"])[int(c) % 8])
+        for c in range(1, 9)
+    ]
+    assert order == [30, 40, 50, 60, 70, 80, 90, 91]
+
+
+def test_ring_append_traces_without_concrete_cursor():
+    """The append is pure JAX (it compiles into the serve programs):
+    jit over both mask ranks, no host round-trips."""
+    f1 = jax.jit(lambda r, v, m: ring_append(r, v, m))
+    ring = _tiny_ring(4)
+    rec = {"a": jnp.int32(7), "b": jnp.ones((2,), jnp.float32)}
+    out = f1(ring, rec, jnp.bool_(True))
+    assert int(out.cursor) == 1
+
+
+# ---------------------------------------------------------------------------
+# config surface (raises BEFORE the AOT compile — cheap)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_config_validation():
+    params, bank, sched = fleet_builder(seed=0)
+    with pytest.raises(ValueError, match="requires record=True"):
+        SessionStore(params, bank, sched, capacity=4, max_batch=2,
+                     ring=8)
+    with pytest.raises(ValueError, match="must be >= max_batch"):
+        SessionStore(params, bank, sched, capacity=4, max_batch=3,
+                     record=True, ring=2)
+    with pytest.raises(ValueError, match="ring_drain requires ring"):
+        SessionStore(params, bank, sched, capacity=4, max_batch=2,
+                     record=True, ring_drain=4)
+    with pytest.raises(ValueError, match="ring_drain"):
+        SessionStore(params, bank, sched, capacity=4, max_batch=2,
+                     record=True, ring=4, ring_drain=9)
+    # the serve: YAML block names both knobs (fail-loud contract)
+    assert {"ring", "ring_drain"} <= set(SERVE_KEYS)
+
+
+# ---------------------------------------------------------------------------
+# ingest_chunk == n x add() (host-only replay, synthetic records)
+# ---------------------------------------------------------------------------
+
+
+class _Rec:
+    """One synthetic served decision, renderable BOTH ways: as the
+    per-decision `add()` result duck-type and as one row of a drained
+    `RingRec` chunk."""
+
+    def __init__(self, sid, seq, k, *, done=False, health=0,
+                 version=0):
+        self.session_id = sid
+        self.seq = seq
+        self.stage_idx = k
+        self.job_idx = k % 3
+        self.num_exec = 2 + (k % 2)
+        self.lgprob = -0.25 * (k + 1)
+        self.reward = -float(k)
+        self.dt = 1.5
+        self.wall_time = float(10 * seq + sid)
+        self.done = done
+        self.decided = True
+        self.health_mask = health
+        self.params_version = version
+        self.obs = {"x": np.full((2, 3), 100 * sid + seq, np.float32)}
+
+
+def _chunk_of(recs: list[_Rec]) -> RingRec:
+    return RingRec(
+        sid=np.asarray([r.session_id for r in recs], np.int32),
+        seq=np.asarray([r.seq for r in recs], np.int32),
+        params_version=np.asarray(
+            [r.params_version for r in recs], np.int32),
+        stage_idx=np.asarray([r.stage_idx for r in recs], np.int32),
+        job_idx=np.asarray([r.job_idx for r in recs], np.int32),
+        num_exec=np.asarray([r.num_exec for r in recs], np.int32),
+        lgprob=np.asarray([r.lgprob for r in recs], np.float32),
+        reward=np.asarray([r.reward for r in recs], np.float32),
+        dt=np.asarray([r.dt for r in recs], np.float32),
+        wall_time=np.asarray([r.wall_time for r in recs], np.float32),
+        done=np.asarray([r.done for r in recs], bool),
+        health_mask=np.asarray(
+            [r.health_mask for r in recs], np.int32),
+        obs={"x": (np.stack([r.obs["x"] for r in recs]) if recs
+                   else np.zeros((0, 2, 3), np.float32))},
+    )
+
+
+def _assert_traj_equal(a, b) -> None:
+    assert a.session_id == b.session_id
+    assert a.length == b.length and a.done == b.done
+    for f in ("stage_idx", "job_idx", "num_exec_k", "lgprob",
+              "reward", "wall_times", "params_version"):
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f))
+    la = jax.tree_util.tree_leaves(a.obs)
+    lb = jax.tree_util.tree_leaves(b.obs)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _drain_sorted(buf):
+    out = buf.drain(10 ** 6)
+    return sorted(out, key=lambda t: (t.session_id, t.wall_times[0]))
+
+
+def test_ingest_chunk_replays_add_exactly():
+    """One drained chunk assembles the SAME trajectories n add()
+    calls do — episode ends, segment cuts, quarantine eviction, and
+    close replay included — regardless of how the stream is cut into
+    chunks."""
+    stream = [
+        _Rec(1, 1, 0), _Rec(2, 1, 0), _Rec(1, 2, 1),
+        _Rec(2, 2, 1, version=1), _Rec(1, 3, 2, done=True),
+        # session 3 trips the sentinel mid-episode: evicted, and the
+        # poisoned record itself never becomes a step
+        _Rec(3, 1, 0), _Rec(3, 2, 1, health=4),
+        # session 2 runs into the max_steps=3 segment cut
+        _Rec(2, 3, 2, version=1), _Rec(2, 4, 3, version=1),
+    ]
+    buf_a = TrajectoryBuffer(capacity=16, max_steps=3,
+                             min_decisions=1)
+    buf_b = TrajectoryBuffer(capacity=16, max_steps=3,
+                             min_decisions=1)
+    for r in stream:
+        buf_a.add(r)
+    # the ring path sees the same stream as two arbitrary chunks
+    buf_b.ingest_chunk(_chunk_of(stream[:4]))
+    buf_b.ingest_chunk(_chunk_of(stream[4:]))
+    # session 2's residual single step closes out on both paths
+    buf_a.on_close(2)
+    buf_b.on_close(2)
+    assert buf_a.stats == buf_b.stats
+    assert buf_a.stats["online_dropped_quarantined"] == 1
+    ta, tb = _drain_sorted(buf_a), _drain_sorted(buf_b)
+    # sid 1 (done), sid 2's segment cut + its close residue; sid 3
+    # was evicted by the quarantine
+    assert len(ta) == len(tb) == 3
+    for x, y in zip(ta, tb):
+        _assert_traj_equal(x, y)
+
+
+def test_ingest_chunk_seq_gap_drops_open_episode():
+    """A per-session seq hole in the drained stream (ring overrun ate
+    records) evicts the CORRUPTED open episode with a counter and
+    restarts assembly at the record after the hole — a spliced
+    trajectory must never reach the learner."""
+    buf = TrajectoryBuffer(capacity=8, max_steps=8, min_decisions=1)
+    buf.ingest_chunk(_chunk_of([_Rec(7, 1, 0), _Rec(7, 2, 1)]))
+    # seq 3..4 lost to an overrun; seq 5 arrives next
+    buf.ingest_chunk(_chunk_of([_Rec(7, 5, 4), _Rec(7, 6, 5,
+                                                    done=True)]))
+    assert buf.stats["online_dropped_gap"] == 1
+    [tr] = buf.drain(4)
+    # only the post-hole contiguous run survives
+    assert tr.length == 2 and tr.done
+    np.testing.assert_array_equal(tr.stage_idx, [4, 5])
+    # and an empty chunk is a no-op
+    buf.ingest_chunk(_chunk_of([]))
+    assert buf.stats["online_decisions"] == 4
+
+
+# ---------------------------------------------------------------------------
+# full-store bit parity + overrun accounting + fleet feed (slow tier:
+# each builds an AOT store / spawns replica processes)
+# ---------------------------------------------------------------------------
+
+
+def _mirror_stores():
+    """A record-on per-decision store and its ring twin: same seed,
+    same two-group geometry, aligned key-consumption counters."""
+    params, bank, sched = fleet_builder(seed=0)
+    buf_a = TrajectoryBuffer(capacity=64, max_steps=6,
+                             min_decisions=1)
+    buf_b = TrajectoryBuffer(capacity=64, max_steps=6,
+                             min_decisions=1)
+    kw = dict(capacity=6, max_batch=3, groups=2, seed=0, record=True)
+    sa = SessionStore(params, bank, sched, collector=buf_a, **kw)
+    sb = SessionStore(params, bank, sched, collector=buf_b,
+                      ring=8, ring_drain=4, **kw)
+    sb._calls = sa._calls
+    return sa, sb, buf_a, buf_b
+
+
+@pytest.mark.slow
+def test_ring_trajectories_bit_identical_to_per_decision_path():
+    """THE ISSUE-18 parity pin: trajectories drained through the
+    device ring are byte-identical to the per-decision record path —
+    obs pytrees, actions, log-probs, rewards, wall clocks,
+    params_version stamps, and episode boundaries — across ring
+    WRAP, slot-GROUP boundaries, a mid-stream QUARANTINE eviction,
+    and a PARAM SWAP landing mid-ring. The ring results themselves
+    carry no per-decision obs payload (that is the point), while
+    every host-visible decision field matches exactly."""
+    from sparksched_tpu.serve.router import _poison_session
+
+    sa, sb, buf_a, buf_b = _mirror_stores()
+    sids = [sa.create(seed=500 + i) for i in range(4)]
+    assert sids == [sb.create(seed=500 + i) for i in range(4)]
+
+    def decide_pair(sid):
+        ra, rb = sa.decide(sid), sb.decide(sid)
+        check_pair(ra, rb)
+        return ra
+
+    def check_pair(ra, rb):
+        assert ra.obs is not None and rb.obs is None
+        for f in ("session_id", "decided", "stage_idx", "job_idx",
+                  "num_exec", "lgprob", "reward", "dt", "wall_time",
+                  "done", "health_mask", "params_version"):
+            assert getattr(ra, f) == getattr(rb, f), f
+
+    def rotate(j, seed):
+        sa.close(sids[j])
+        sb.close(sids[j])
+        sids[j] = sa.create(seed=seed)
+        assert sids[j] == sb.create(seed=seed)
+
+    poisoned = swapped = False
+    fresh_seed = 600
+    for rnd in range(10):
+        if rnd == 3 and not poisoned:
+            # mid-stream quarantine: the poisoned decision's episode
+            # is evicted on BOTH paths, then the close replays
+            # quarantined through the ring's deferred close event
+            poisoned = True
+            _poison_session(sa, sids[1])
+            _poison_session(sb, sids[1])
+            ra, rb = sa.decide(sids[1]), sb.decide(sids[1])
+            check_pair(ra, rb)
+            assert ra.health_mask != 0
+            rotate(1, fresh_seed)
+            fresh_seed += 1
+        if rnd == 5 and not swapped:
+            # param swap mid-ring: records before/after the boundary
+            # carry their DISPATCH version on both paths
+            swapped = True
+            bumped = jax.device_get(jax.tree_util.tree_map(
+                lambda x: x * 1.01, sa.model_params
+            ))
+            assert sa.set_params(bumped, version=9) == 9
+            assert sb.set_params(bumped, version=9) == 9
+        # per-group batched decides (a batch lives in ONE group) with
+        # a single-decide residue — both call shapes feed the ring
+        for g in (0, 1):
+            gsids = [s for s in sids if sa.session_group(s) == g]
+            assert gsids == [
+                s for s in sids if sb.session_group(s) == g
+            ]
+            ras = []
+            if len(gsids) > 1:
+                ras = sa.decide_batch(gsids)
+                rbs = sb.decide_batch(gsids)
+                for ra, rb in zip(ras, rbs):
+                    check_pair(ra, rb)
+            elif gsids:
+                ras = [decide_pair(gsids[0])]
+            for ra in ras:
+                if ra.done or ra.health_mask:
+                    rotate(sids.index(ra.session_id), fresh_seed)
+                    fresh_seed += 1
+    for s in sids:
+        sa.close(s)
+        sb.close(s)
+    sb.drain_ring(wait=True)
+
+    # the ring actually wrapped (cursor well past depth 8), and the
+    # safe default-adjacent cadence lost nothing
+    assert sb.stats["serve_ring_records"] > 2 * sb.ring_size
+    assert sb.stats["serve_ring_dropped"] == 0
+    assert sb.stats["serve_ring_drains"] > 0
+    assert buf_a.stats == buf_b.stats
+    assert buf_a.stats["online_dropped_quarantined"] >= 1
+    ta, tb = _drain_sorted(buf_a), _drain_sorted(buf_b)
+    assert len(ta) == len(tb) > 0
+    for x, y in zip(ta, tb):
+        _assert_traj_equal(x, y)
+    # swap landed mid-stream: both version stamps appear in the data
+    versions = {int(v) for t in ta for v in t.params_version}
+    assert {0, 9} <= versions
+
+
+@pytest.mark.slow
+def test_ring_overrun_is_counted_never_spliced():
+    """An EXPLICIT tighter-than-safe cadence can overrun: the store
+    counts exactly the records the wrap overwrote
+    (`serve_ring_dropped`), and the buffer's seq-gap guard evicts the
+    episode the hole corrupted (`online_dropped_gap`) instead of
+    splicing across it."""
+    params, bank, sched = fleet_builder(seed=0)
+    buf = TrajectoryBuffer(capacity=16, max_steps=16,
+                           min_decisions=1)
+    st = SessionStore(
+        params, bank, sched, capacity=4, max_batch=3, seed=0,
+        record=True, ring=3, ring_drain=3, collector=buf,
+    )
+    s0 = st.create(seed=800)
+    others = [st.create(seed=801 + i) for i in range(3)]
+    st.decide(s0)
+    st.drain_ring(wait=True)  # seq 1 ingested; s0's episode is open
+    # two more s0 decisions park in the ring (pot 2 < cadence 3),
+    # then one full batch bursts the pot to 5 — the cadence snapshot
+    # fires on a 5-record span over a depth-3 ring: the two oldest
+    # records (s0 seq 2..3) are gone
+    st.decide(s0)
+    st.decide(s0)
+    st.decide_batch(others)
+    st.decide(s0)  # seq 5 arrives AFTER the hole
+    st.drain_ring(wait=True)
+    assert st.stats["serve_ring_dropped"] == 2
+    assert buf.stats["online_dropped_gap"] == 1
+    for s in [s0, *others]:
+        st.close(s)
+    st.drain_ring(wait=True)
+    # s0's surviving trajectory restarts AFTER the hole — one step
+    # (seq 5), never a 1-then-5 splice
+    t0 = [t for t in _drain_sorted(buf) if t.session_id == s0]
+    assert [t.length for t in t0] == [1]
+
+
+@pytest.mark.slow
+def test_ring_fleet_streams_chunks_to_one_learner():
+    """The wire half of ISSUE 18: a REAL 2-replica fleet serving
+    ring-on stores ships drained chunks over the pipes in batches
+    (`ring_chunks` — no per-decision RPCs), the router remaps whole
+    sid arrays into the global space, ONE central buffer assembles
+    trajectories from both replicas, and the learner publishes a
+    finite-loss update that lands fleet-wide through the bus."""
+    from sparksched_tpu.online import (
+        OnlineLearner,
+        ParamBus,
+        make_learner_trainer,
+    )
+    from sparksched_tpu.serve.router import ReplicaSpec, Router
+
+    params, bank, sched = fleet_builder(seed=0)
+    buf = TrajectoryBuffer(capacity=64, max_steps=8, min_decisions=2)
+    spec = ReplicaSpec(
+        builder="tests.test_serve_net:fleet_builder",
+        builder_kwargs={"seed": 0},
+        serve_cfg={"capacity": 6, "max_batch": 3, "record": True,
+                   "ring": 8, "ring_drain": 4},
+    )
+    router = Router(spec, replicas=2, collector=buf)
+    try:
+        trainer = make_learner_trainer(AGENT_CFG, params, 2, 8,
+                                       seed=0)
+        bus = ParamBus(router, probation_decisions=4,
+                       max_quarantine_rate=0.9)
+        learner = OnlineLearner(
+            trainer, buf, bus, max_param_lag=16, swap_every=1,
+            init_params=sched.params, version0=0,
+        )
+        sids = [router.create(seed=700 + i) for i in range(4)]
+        assert {router.replica_of(s) for s in sids} == {0, 1}
+        created = set(sids)
+        guard = 0
+        while len(buf) < learner.B and guard < 200:
+            guard += 1
+            tks = [router.submit(s) for s in sids]
+            router.flush()
+            for j, (s, tk) in enumerate(zip(sids, tks)):
+                if (tk.error is not None or tk.result.done
+                        or tk.result.health_mask):
+                    router.close(s)
+                    sids[j] = router.create(
+                        seed=730 + guard * 4 + j
+                    )
+                    created.add(sids[j])
+            router.ring_pump(force=True)
+        assert len(buf) >= learner.B, (
+            buf.stats, router.fleet_stats()
+        )
+        # the buffer speaks GLOBAL sids: every open/assembled session
+        # id came from the router's own create path
+        assert set(buf._open) <= created
+        assert learner.ready()
+        info = learner.step()
+        assert info is not None and info["accepted"], info
+        assert np.isfinite(info["policy_loss"])
+        assert learner.version == 1
+        ev = bus.pump()
+        assert ev == {"event": "swap", "version": 1}
+        assert router.params_version == 1
+        tk = router.submit(sids[0])
+        router.flush()
+        assert tk.error is None and tk.result.params_version == 1
+        fs = router.fleet_stats()
+        assert fs["serve_ring_records"] >= buf.stats[
+            "online_decisions"]
+        assert fs["serve_ring_drains"] >= 2  # both replicas drained
+        for s in sids:
+            router.close(s)
+    finally:
+        router.stop()
