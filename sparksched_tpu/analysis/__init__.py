@@ -29,6 +29,16 @@ package makes TPU-hostility a CI failure, via three passes:
   schemas are data the auditor reads via `jax.eval_shape`) plus a
   cheap runtime-assert mode tests use to pin that reset/step never
   drift structure, dtype, or shape (the recompile hazard).
+- `coverage`: registry coverage — every `jax.jit`/AOT site in the
+  package must map to a registered jaxpr-audit program or carry an
+  explicit waiver (`coverage.COVERAGE`), closing the silent-gap
+  failure mode as the program surface grows.
+- `concurrency`: host-thread ownership + lock discipline over the
+  serve/online stack — a thread-role call graph seeded at every
+  `threading.Thread` spawn site, a declarative attribute OWNERSHIP
+  table, non-owner-write / unlocked-shared / lock-order /
+  blocking-under-lock / pump-blocking rules, and cross-validation of
+  the runtime `assert_owner` placements (`sparksched_tpu.ownership`).
 - `memory`: HBM-byte observability (ISSUE 5 tentpole) — per-program
   trace-time byte accounting under the TPU tiled-layout model, the
   `bank-broadcast` rule (no vmapped lane program may contain a
@@ -75,7 +85,8 @@ class Violation:
         return f"[{self.passname}/{self.rule}] {self.where}: {self.detail}"
 
 
-DEFAULT_PASSES = ("lint", "contracts", "jaxpr", "memory")
+DEFAULT_PASSES = ("lint", "coverage", "concurrency", "contracts",
+                  "jaxpr", "memory")
 
 
 def run_all(passes: tuple[str, ...] = DEFAULT_PASSES,
@@ -101,6 +112,17 @@ def run_all(passes: tuple[str, ...] = DEFAULT_PASSES,
 
             vs = lint.lint_package()
             extra: dict[str, Any] = {"files_scanned": lint.last_scan_count()}
+        elif p == "coverage":
+            from . import coverage
+
+            vs = coverage.check_package()
+            extra = {"files_scanned": coverage.last_scan_count(),
+                     "sites_registered": len(coverage.COVERAGE)}
+        elif p == "concurrency":
+            from . import concurrency
+
+            vs = concurrency.check_package()
+            extra = {"files_scanned": concurrency.last_scan_count()}
         elif p == "contracts":
             from . import contracts
 

@@ -2,10 +2,12 @@
 print a JSON report, exit non-zero on any violation.
 
 Flags:
-  --passes lint,contracts,jaxpr,memory
+  --passes lint,coverage,concurrency,contracts,jaxpr,memory
                                   subset to run (default: all,
                                   cheap-first); `--passes memory` runs
-                                  the HBM memory pass alone
+                                  the HBM memory pass alone,
+                                  `--passes concurrency` the host
+                                  thread-ownership pass alone
   --quiet                         violations-only JSON (no measured
                                   counts) — the bench stamp subprocess
                                   uses this
@@ -39,8 +41,10 @@ def main(argv: list[str] | None = None) -> int:
         "lint + pytree contracts)",
     )
     ap.add_argument(
-        "--passes", default="lint,contracts,jaxpr,memory",
-        help="comma-separated subset of lint,contracts,jaxpr,memory",
+        "--passes",
+        default="lint,coverage,concurrency,contracts,jaxpr,memory",
+        help="comma-separated subset of lint,coverage,concurrency,"
+        "contracts,jaxpr,memory",
     )
     ap.add_argument(
         "--quiet", action="store_true",

@@ -1,0 +1,1147 @@
+"""Concurrency ownership pass (ISSUE 19): thread-ownership +
+lock-discipline static analysis over the serve/online host stack.
+
+The traced-code passes (lint/contracts/jaxpr/memory) police what runs
+ON device. This pass polices the host threads AROUND the device: the
+serve pump, the HTTP handler pool, the optional harvester, the online
+learner, the fleet collector. Its model has two declarative halves:
+
+1. a **thread-role call graph** — roles are seeded at every
+   `threading.Thread(target=...)` spawn site (the spawn's `name=` is
+   the role: the shipped sites all name their threads `serve-pump`,
+   `serve-harvester`, `online-learner`, `fleet-collector`,
+   `serve-client-<i>`) plus `DECLARED_ENTRY_POINTS` for threads the
+   stdlib spawns for us (the HTTP handler pool), then propagated
+   through method calls (self-calls, dispatch-table references, and
+   cross-class calls typed by `ATTR_TYPES`);
+2. an **OWNERSHIP table** mapping each mutable attribute of the host
+   classes to its owning role(s), its guarding lock, or a sanctioned
+   `handoff` object (Queue / Event / Condition transfer — internally
+   synchronized).
+
+Rules (ids are what `# analysis: allow(<rule>)` pragmas and the JSON
+report use):
+
+- ``concurrency-nonowner-write``: a write to a role-owned attribute
+  in a method reachable from a role other than the owner(s).
+- ``concurrency-unlocked-shared``: an access to a lock-guarded
+  attribute outside a `with <lock>:` block (`__init__` is exempt —
+  construction happens-before thread start; so are declared
+  caller-holds-the-lock helpers, see `LOCKED_BODY_FUNCS` and the
+  `*_locked` naming convention, whose call sites must themselves
+  hold a class lock); also an UNDECLARED attribute of a checked
+  class written and accessed from >= 2 distinct non-main roles with
+  no lock held at every site — the table must grow with the code.
+- ``concurrency-lock-order``: a cycle in the lock-acquisition graph
+  (edges: lock A held while lock B is acquired, lexically or through
+  the call graph). Includes re-acquiring a held non-reentrant lock.
+- ``concurrency-blocking-under-lock``: a blocking call
+  (`block_until_ready` / `device_get`, an unbounded `Queue.get` /
+  `Event.wait` / `Thread.join`, socket/pipe receives) made while
+  holding a lock. `Condition.wait` on the condition being held is
+  the sanctioned CV pattern (wait releases it); locks in `IO_LOCKS`
+  exist to serialize a blocking channel and are exempt.
+- ``concurrency-pump-blocking``: a blocking call from a method
+  reachable from the `serve-pump` role outside the harvest boundary
+  (`SERVE_HARVEST_FUNCS` + the drain/lifecycle funcs) — the
+  role-propagated generalization of lint's file-scoped
+  ``serve-host-sync`` (ISSUE 15), which it absorbs: the same
+  boundary set, applied wherever pump-reachable code lives.
+- ``concurrency-stale-ownership`` / ``concurrency-assert-placement``
+  (package scan only): the OWNERSHIP table and the runtime
+  `assert_owner` placements (`sparksched_tpu/ownership.py`) must
+  match the code — a table entry whose class/attribute no longer
+  exists, or an `assert_owner` call site that differs from
+  `RUNTIME_ASSERT_SITES`, is itself a violation, so the static
+  model, the runtime checks, and the code cannot drift apart.
+
+The `main` thread is ownership-polymorphic: it constructs everything
+and drives the whole stack in single-threaded benches, so reachability
+from `main` alone never violates ownership (the runtime half —
+`SPARKSCHED_DEBUG_OWNERSHIP=1` — covers dynamic single-owner binding).
+
+Like lint, scoping keys on paths RELATIVE to the scanned root, so a
+fixture tree mirroring the package layout gets identical treatment,
+and ownership can be declared inline for fixture/new classes with
+``# owner: <role>[, <role>]`` / ``# lock: <attr>`` pragmas on the
+attribute's assignment line.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+from . import Violation
+from .lint import (
+    SERVE_HARVEST_FUNCS,
+    _dotted,
+    _import_table,
+    _pragmas,
+    iter_package_files,
+)
+
+# --- declarative model ------------------------------------------------------
+
+KNOWN_ROLES = (
+    "main",
+    "serve-pump",
+    "serve-http",
+    "serve-harvester",
+    "serve-client",
+    "online-learner",
+    "fleet-collector",
+)
+
+# Threads the stdlib spawns for us: ThreadingHTTPServer's handler pool
+# enters the package through ServeServer._submit_op.
+DECLARED_ENTRY_POINTS: dict[tuple[str, str], str] = {
+    ("serve/server.py", "ServeServer._submit_op"): "serve-http",
+}
+
+# Cross-class call typing: (class, attribute) -> candidate classes the
+# attribute may hold at runtime. `self.<attr>.<meth>(...)` adds a call
+# edge to every candidate that defines <meth>. Duck-typed slots list
+# every shipped implementation (ServeServer serves a SessionStore, a
+# batcher front, or a whole Router fleet through the same protocol).
+ATTR_TYPES: dict[tuple[str, str], tuple[str, ...]] = {
+    ("ServeServer", "store"): ("SessionStore", "Router"),
+    ("ServeServer", "front"): (
+        "ContinuousBatcher", "MicroBatcher", "Router",
+    ),
+    ("ServeServer", "collector"): ("FleetCollector",),
+    ("ServeServer", "metrics"): ("MetricsRegistry",),
+    ("ServeServer", "runlog"): ("RunLog",),
+    ("ContinuousBatcher", "store"): ("SessionStore",),
+    ("ContinuousBatcher", "metrics"): ("MetricsRegistry",),
+    ("ContinuousBatcher", "runlog"): ("RunLog",),
+    ("MicroBatcher", "store"): ("SessionStore",),
+    ("MicroBatcher", "metrics"): ("MetricsRegistry",),
+    ("MicroBatcher", "runlog"): ("RunLog",),
+    ("SessionStore", "collector"): ("TrajectoryBuffer",),
+    ("SessionStore", "metrics"): ("MetricsRegistry",),
+    ("SessionStore", "_runlog"): ("RunLog",),
+    ("Router", "collector"): ("TrajectoryBuffer",),
+    ("Router", "metrics"): ("MetricsRegistry",),
+    ("Router", "runlog"): ("RunLog",),
+    ("OnlineLearner", "buffer"): ("TrajectoryBuffer",),
+    ("OnlineLearner", "bus"): ("ParamBus",),
+    ("OnlineLearner", "metrics"): ("MetricsRegistry",),
+    ("OnlineLearner", "runlog"): ("RunLog",),
+    ("ParamBus", "store"): ("SessionStore", "Router"),
+    ("ParamBus", "metrics"): ("MetricsRegistry",),
+    ("ParamBus", "runlog"): ("RunLog",),
+    ("TrajectoryBuffer", "metrics"): ("MetricsRegistry",),
+    ("FleetCollector", "backend"): ("Router", "SessionStore"),
+    ("FleetCollector", "runlog"): ("RunLog",),
+    ("ServeClient", "metrics"): ("MetricsRegistry",),
+    ("ServeClient", "runlog"): ("RunLog",),
+}
+
+# Plain-callable attributes (bound methods injected by composition
+# roots): calling `self.<attr>()` calls the listed targets.
+CALLABLE_ATTRS: dict[tuple[str, str], tuple[tuple[str, str], ...]] = {
+    # server_from_config wires on_poll=bus.pump onto the pump loop
+    ("ServeServer", "on_poll"): (("ParamBus", "pump"),),
+}
+
+# spec forms:
+#   ("role", ("<role>", ...)) - single-owner state; listed roles are
+#       the sanctioned drivers (more than one ONLY when the modes are
+#       mutually exclusive by contract and the runtime binding picks
+#       the live one, e.g. FleetCollector's ride-the-pump vs own-thread
+#       modes); writes from any other non-main role violate.
+#   ("lock", "<attr>")        - every access outside __init__ must
+#       hold the lock (Condition aliases resolve to their Lock).
+#   ("handoff", "<why>")      - internally-synchronized transfer
+#       object (Queue / Event); excluded from attribute checks.
+OWNERSHIP: dict[str, dict[str, tuple[str, Any]]] = {
+    "SessionStore": {
+        # device state + session bookkeeping: the single serving thread
+        "_stores": ("role", ("serve-pump",)),
+        "_model_params": ("role", ("serve-pump",)),
+        "params_version": ("role", ("serve-pump",)),
+        "_last_good_params": ("role", ("serve-pump",)),
+        "_last_good_version": ("role", ("serve-pump",)),
+        "last_spans": ("role", ("serve-pump",)),
+        "_calls": ("role", ("serve-pump",)),
+        "_rings": ("role", ("serve-pump",)),
+        "_ring_pot": ("role", ("serve-pump",)),
+        "_ring_drained": ("role", ("serve-pump",)),
+        "_ring_pending": ("role", ("serve-pump",)),
+        "_ring_mute": ("role", ("serve-pump",)),
+        "ring_sink": ("role", ("serve-pump",)),
+        "_live": ("role", ("serve-pump",)),
+        "_quarantined": ("role", ("serve-pump",)),
+        "_slot_of": ("role", ("serve-pump",)),
+        "_sid_of": ("role", ("serve-pump",)),
+        "_group_of": ("role", ("serve-pump",)),
+        "_gen": ("role", ("serve-pump",)),
+        "_free_sids": ("role", ("serve-pump",)),
+        "_free_slots": ("role", ("serve-pump",)),
+        "_cold": ("role", ("serve-pump",)),
+        "_wb_pending": ("role", ("serve-pump",)),
+        "_last_use": ("role", ("serve-pump",)),
+        "_tick": ("role", ("serve-pump",)),
+        "wall_split": ("role", ("serve-pump",)),
+        "stats": ("role", ("serve-pump",)),
+        "_harvester": ("role", ("serve-pump",)),
+        # the serving<->harvester handshake: deque + claim flags, all
+        # touched under the condition only
+        "_inflight": ("lock", "_harvest_cv"),
+        "_harvester_stop": ("lock", "_harvest_cv"),
+    },
+    "ContinuousBatcher": {
+        "_queues": ("role", ("serve-pump",)),
+        "_rotation": ("role", ("serve-pump",)),
+        "_skips": ("role", ("serve-pump",)),
+    },
+    "MicroBatcher": {
+        "_pending": ("role", ("serve-pump",)),
+    },
+    "ServeServer": {
+        # "no locks by construction": only the pump thread touches
+        # tenancy/quota bookkeeping (handlers just enqueue ops)
+        "_tenant_of": ("role", ("serve-pump",)),
+        "_sessions_by_tenant": ("role", ("serve-pump",)),
+        "_inflight_by_tenant": ("role", ("serve-pump",)),
+        "_q": ("handoff", "queue.Queue is internally locked"),
+        "_stop": ("handoff", "threading.Event"),
+    },
+    "Router": {
+        # fleet bookkeeping rides whoever drives the router: the serve
+        # pump in the server integration, or the collector thread in
+        # FleetCollector.start() mode - mutually exclusive by the
+        # collector's contract; the runtime binding enforces the live
+        # single owner.
+        "params_version": ("role", ("serve-pump", "fleet-collector")),
+        "stats": ("role", ("serve-pump", "fleet-collector")),
+        "_rid": ("role", ("serve-pump", "fleet-collector")),
+        "_tickets": ("role", ("serve-pump", "fleet-collector")),
+        "_replies": ("role", ("serve-pump", "fleet-collector")),
+        "_reply_owner": ("role", ("serve-pump", "fleet-collector")),
+        "_sid_map": ("role", ("serve-pump", "fleet-collector")),
+        "_failed": ("role", ("serve-pump", "fleet-collector")),
+        "_stopped": ("role", ("serve-pump", "fleet-collector")),
+        "_replicas": ("role", ("serve-pump", "fleet-collector")),
+        "_ring_next": ("role", ("serve-pump", "fleet-collector")),
+    },
+    "TrajectoryBuffer": {
+        # producer (pump: add/ingest_chunk/on_close) vs consumer
+        # (learner: drain/requeue) - the one genuinely two-role
+        # structure; everything goes through the lock
+        "_open": ("lock", "_lock"),
+        "_done": ("lock", "_lock"),
+        "stats": ("lock", "_lock"),
+    },
+    "ParamBus": {
+        "_pending": ("lock", "_lock"),
+        "stats": ("lock", "_lock"),
+        # probation state is serving-side only (pump applies / judges)
+        "_proven": ("role", ("serve-pump",)),
+        "_probation": ("role", ("serve-pump",)),
+    },
+    "OnlineLearner": {
+        "state": ("role", ("online-learner",)),
+        "version": ("role", ("online-learner",)),
+        "stats": ("role", ("online-learner",)),
+        "history": ("role", ("online-learner",)),
+    },
+    "RunLog": {
+        # "thread-safe by contract: the JIT hooks fire from whatever
+        # thread compiles"
+        "_fp": ("lock", "_lock"),
+        "_closed": ("lock", "_lock"),
+        "_rotations": ("lock", "_lock"),
+    },
+    "FleetCollector": {
+        # ride-the-owner-loop (maybe_scrape on the pump) or own thread
+        # (start(), poll-safe backends) - mutually exclusive modes
+        "_prev": ("role", ("serve-pump", "fleet-collector")),
+        "_last_scrape": ("role", ("serve-pump", "fleet-collector")),
+        "last_status": ("role", ("serve-pump", "fleet-collector")),
+        "stats": ("role", ("serve-pump", "fleet-collector")),
+    },
+    "MetricsRegistry": {
+        # shared by every role that instruments (pump, client workers,
+        # learner, collector): the one registry-wide lock (ISSUE 19
+        # race fix - see obs/metrics.py docstring for the cost math)
+        "counters": ("lock", "_lock"),
+        "gauges": ("lock", "_lock"),
+        "hists": ("lock", "_lock"),
+    },
+}
+
+# Helpers whose body runs with a lock held without a lexical `with`:
+# either caller-holds-the-lock contracts (TrajectoryBuffer._count, the
+# `*_locked` suffix convention) or self-managed non-blocking acquires
+# (RunLog._teardown - signal context, see its docstring).
+LOCKED_BODY_FUNCS: dict[tuple[str, str], str] = {
+    ("TrajectoryBuffer", "_count"): "_lock",
+    ("RunLog", "_teardown"): "_lock",
+}
+
+# Locks whose purpose is serializing a blocking channel - holding them
+# across the blocking call IS the design (ServeClient's sync HTTP
+# connection), so concurrency-blocking-under-lock exempts them.
+IO_LOCKS: frozenset[tuple[str, str]] = frozenset({
+    ("ServeClient", "_sync_lock"),
+})
+
+# Files whose pump-reachable blocking calls are the product: the
+# router's pipe round-trips ARE the replica transport (mirrors lint's
+# HOST_FILES rationale for the generic host-sync rule).
+PUMP_BLOCKING_EXEMPT_FILES = frozenset({"serve/router.py"})
+
+# The harvest boundary for concurrency-pump-blocking: lint's
+# SERVE_HARVEST_FUNCS (the sanctioned sync stage of the pipelined
+# front) plus the drain/lifecycle methods that block by contract.
+PUMP_BOUNDARY_FUNCS = frozenset(SERVE_HARVEST_FUNCS) | {
+    "flush", "stop", "stop_harvester", "close_all", "drain_ring",
+    "warmup",
+}
+
+# Runtime assert_owner placements (sparksched_tpu/ownership.py): the
+# hot entry points of every role-owned structure. The package scan
+# fails (concurrency-assert-placement) when the assert_owner calls
+# found in source differ from this table, and the tests cross-validate
+# the roles against OWNERSHIP - the three layers cannot drift apart.
+RUNTIME_ASSERT_SITES: dict[tuple[str, str], tuple[str, ...]] = {
+    ("serve/session.py", "SessionStore.create"): ("serve-pump",),
+    ("serve/session.py", "SessionStore.close"): ("serve-pump",),
+    ("serve/session.py", "SessionStore.decide"): ("serve-pump",),
+    ("serve/session.py", "SessionStore.decide_batch"):
+        ("serve-pump",),
+    ("serve/session.py", "SessionStore.dispatch_batch"):
+        ("serve-pump",),
+    ("serve/session.py", "SessionStore.set_params"): ("serve-pump",),
+    ("serve/session.py", "ContinuousBatcher.submit"): ("serve-pump",),
+    ("serve/session.py", "ContinuousBatcher.pump"): ("serve-pump",),
+    ("serve/session.py", "MicroBatcher.submit"): ("serve-pump",),
+    ("serve/session.py", "MicroBatcher.flush"): ("serve-pump",),
+    ("serve/server.py", "ServeServer._handle_op"): ("serve-pump",),
+    ("serve/router.py", "Router.submit"):
+        ("serve-pump", "fleet-collector"),
+    ("serve/router.py", "Router.poll"):
+        ("serve-pump", "fleet-collector"),
+    ("online/bus.py", "ParamBus.publish"): ("online-learner",),
+    ("online/bus.py", "ParamBus.pump"): ("serve-pump",),
+    ("online/learner.py", "OnlineLearner.step"): ("online-learner",),
+    ("obs/fleet.py", "FleetCollector.scrape"):
+        ("serve-pump", "fleet-collector"),
+}
+
+# mutating container methods: a call `self.<attr>.<m>(...)` with m in
+# this set is a WRITE to <attr>
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault", "sort", "reverse", "put", "put_nowait",
+})
+
+_SOCKET_BLOCKING = frozenset({"accept", "recv", "recvfrom",
+                              "getresponse"})
+
+_OWNER_PRAGMA_RE = re.compile(
+    r"#\s*owner:\s*([a-z\-]+(?:\s*,\s*[a-z\-]+)*)")
+_LOCK_PRAGMA_RE = re.compile(r"#\s*lock:\s*([A-Za-z_]\w*)")
+
+_last_scan_count = 0
+
+
+def last_scan_count() -> int:
+    return _last_scan_count
+
+
+def runtime_assert_expectations() -> dict[tuple[str, str],
+                                          tuple[str, ...]]:
+    """The declared assert_owner placements, for cross-validation in
+    tests (static table <-> runtime checks <-> code)."""
+    return dict(RUNTIME_ASSERT_SITES)
+
+
+def _spec_pragmas(source: str) -> dict[int, tuple[str, Any]]:
+    """lineno -> ownership spec declared inline on that line."""
+    out: dict[int, tuple[str, Any]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _OWNER_PRAGMA_RE.search(line)
+        if m:
+            roles = tuple(r.strip() for r in m.group(1).split(","))
+            out[i] = ("role", roles)
+            continue
+        m = _LOCK_PRAGMA_RE.search(line)
+        if m:
+            out[i] = ("lock", m.group(1))
+    return out
+
+
+# --- per-file collection ----------------------------------------------------
+
+
+@dataclass
+class _Access:
+    attr: str
+    write: bool
+    lineno: int
+    held: frozenset[str]
+    in_init: bool
+
+
+@dataclass
+class _FuncInfo:
+    cls: str
+    name: str  # "meth" or "meth.nested"
+    relpath: str
+    accesses: list[_Access] = field(default_factory=list)
+    # (method-name referenced via self.<name>, lineno, held)
+    self_refs: list[tuple[str, int, frozenset[str]]] = \
+        field(default_factory=list)
+    # bare-Name loads (resolve nested defs later)
+    name_refs: list[tuple[str, int, frozenset[str]]] = \
+        field(default_factory=list)
+    # (attr, meth, lineno, held) for self.<attr>.<meth>(...)
+    typed_calls: list[tuple[str, str, int, frozenset[str]]] = \
+        field(default_factory=list)
+    callable_refs: list[tuple[str, int, frozenset[str]]] = \
+        field(default_factory=list)
+    # (lock-attr acquired, lineno, locks held before)
+    acquisitions: list[tuple[str, int, frozenset[str]]] = \
+        field(default_factory=list)
+    # (description, lineno, held, wait-on-lock-attr-or-None)
+    blocking: list[tuple[str, int, frozenset[str], str | None]] = \
+        field(default_factory=list)
+    # (target key-in-class, role, lineno)
+    spawns: list[tuple[str, str, int]] = field(default_factory=list)
+    assert_roles: tuple[str, ...] | None = None
+    assert_line: int = 0
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    relpath: str
+    locks: dict[str, str] = field(default_factory=dict)  # attr->root
+    events: set[str] = field(default_factory=set)
+    queues: set[str] = field(default_factory=set)
+    threads: set[str] = field(default_factory=set)
+    method_names: set[str] = field(default_factory=set)
+    funcs: dict[str, _FuncInfo] = field(default_factory=dict)
+    pragma_specs: dict[str, tuple[str, Any]] = \
+        field(default_factory=dict)
+    assigned_attrs: set[str] = field(default_factory=set)
+    spawns_threads: bool = False
+
+
+def _canonical(imports: dict[str, str], node: ast.AST) -> str:
+    name = _dotted(node)
+    if not name:
+        return ""
+    head, _, rest = name.partition(".")
+    head = imports.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _root_self_attr(node: ast.AST) -> str | None:
+    """The `x` of any `self.x[...].y...` receiver chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        a = _self_attr(node)
+        if a is not None:
+            return a
+        node = node.value
+    return None
+
+
+def _is_bounded(call: ast.Call) -> bool:
+    if call.args:
+        return True
+    return any(kw.arg in ("timeout", "block") for kw in call.keywords)
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    def __init__(self, cls: _ClassInfo, info: _FuncInfo,
+                 imports: dict[str, str]) -> None:
+        self.cls = cls
+        self.info = info
+        self.imports = imports
+        self.held: list[str] = []  # resolved lock attrs, innermost last
+
+    def _held(self) -> frozenset[str]:
+        return frozenset(self.held)
+
+    def _in_init(self) -> bool:
+        return self.info.name == "__init__"
+
+    # -- scoping ----------------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a nested def runs later, on whatever thread calls it - fresh
+        # lock context, own node in the role graph
+        nested = _FuncInfo(self.cls.name,
+                           f"{self.info.name}.{node.name}",
+                           self.info.relpath)
+        self.cls.funcs[nested.name] = nested
+        sub = _FuncVisitor(self.cls, nested, self.imports)
+        for stmt in node.body:
+            sub.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        got: list[str] = []
+        for item in node.items:
+            a = _self_attr(item.context_expr)
+            if a is not None and a in self.cls.locks:
+                root = self.cls.locks[a]
+                self.info.acquisitions.append(
+                    (root, node.lineno, self._held()))
+                self.held.append(root)
+                got.append(root)
+        for item in node.items:
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in got:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- accesses ----------------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        a = _self_attr(node)
+        if a is not None:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.info.accesses.append(_Access(
+                a, write, node.lineno, self._held(), self._in_init()))
+            if not write and a in self.cls.method_names:
+                self.info.self_refs.append(
+                    (a, node.lineno, self._held()))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            a = _root_self_attr(node.value)
+            if a is not None:
+                self.info.accesses.append(_Access(
+                    a, True, node.lineno, self._held(),
+                    self._in_init()))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.info.name_refs.append(
+                (node.id, node.lineno, self._held()))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        canon = _canonical(self.imports, node.func)
+        f = node.func
+
+        if canon == "threading.Thread":
+            self._spawn(node)
+        elif (canon.endswith("ownership.assert_owner")
+                or canon == "assert_owner"):
+            roles = tuple(
+                a.value for a in node.args[1:]
+                if isinstance(a, ast.Constant)
+                and isinstance(a.value, str))
+            if roles:
+                self.info.assert_roles = roles
+                self.info.assert_line = node.lineno
+        elif canon in ("jax.block_until_ready", "jax.device_get"):
+            self.info.blocking.append(
+                (canon, node.lineno, self._held(), None))
+
+        if isinstance(f, ast.Attribute):
+            m = f.attr
+            recv = f.value
+            recv_attr = _self_attr(recv)
+            if (m == "block_until_ready"
+                    and canon != "jax.block_until_ready"):
+                # method form `x.block_until_ready()`; the module
+                # form was already recorded by the canonical match
+                self.info.blocking.append(
+                    ("block_until_ready", node.lineno, self._held(),
+                     None))
+            elif m in _SOCKET_BLOCKING:
+                self.info.blocking.append(
+                    (f"socket/pipe .{m}()", node.lineno, self._held(),
+                     None))
+            if recv_attr is not None:
+                if (m == "get" and recv_attr in self.cls.queues
+                        and not _is_bounded(node)):
+                    self.info.blocking.append(
+                        (f"unbounded {recv_attr}.get()", node.lineno,
+                         self._held(), None))
+                elif (m == "wait"
+                        and (recv_attr in self.cls.events
+                             or recv_attr in self.cls.locks)
+                        and not _is_bounded(node)):
+                    lock = self.cls.locks.get(recv_attr)
+                    self.info.blocking.append(
+                        (f"unbounded {recv_attr}.wait()", node.lineno,
+                         self._held(), lock))
+                elif (m == "join" and recv_attr in self.cls.threads
+                        and not _is_bounded(node)):
+                    self.info.blocking.append(
+                        (f"unbounded {recv_attr}.join()", node.lineno,
+                         self._held(), None))
+            # typed cross-class call: self.<attr>.<meth>(...)
+            r2 = _self_attr(recv)
+            if r2 is not None and m not in _MUTATORS:
+                self.info.typed_calls.append(
+                    (r2, m, node.lineno, self._held()))
+            # container mutation: self.<attr>.append(...) etc.
+            root = _root_self_attr(recv)
+            if root is not None and m in _MUTATORS:
+                self.info.accesses.append(_Access(
+                    root, True, node.lineno, self._held(),
+                    self._in_init()))
+            # callable attribute: self.on_poll(...)
+            a = _self_attr(f)
+            if a is not None and a not in self.cls.method_names:
+                self.info.callable_refs.append(
+                    (a, node.lineno, self._held()))
+        self.generic_visit(node)
+
+    def _spawn(self, node: ast.Call) -> None:
+        self.cls.spawns_threads = True
+        target = None
+        name = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "name":
+                name = kw.value
+        if target is None:
+            return
+        key = None
+        tname = None
+        a = _self_attr(target)
+        if a is not None:
+            key, tname = a, a
+        elif isinstance(target, ast.Name):
+            key = f"{self.info.name}.{target.id}"
+            tname = target.id
+        if key is None:
+            return
+        role = tname or ""
+        if isinstance(name, ast.Constant) and isinstance(name.value,
+                                                         str):
+            role = name.value
+        elif isinstance(name, ast.JoinedStr):
+            # f"serve-client-{i}" -> role "serve-client"
+            parts = [v.value for v in name.values
+                     if isinstance(v, ast.Constant)]
+            role = "".join(parts).rstrip("-") or role
+        self.info.spawns.append((key, role, node.lineno))
+
+
+class _FileScan:
+    def __init__(self, relpath: str, source: str,
+                 tree: ast.AST) -> None:
+        self.relpath = relpath
+        self.pragmas = _pragmas(source)
+        self.spec_pragmas = _spec_pragmas(source)
+        self.imports = _import_table(tree)
+        self.classes: dict[str, _ClassInfo] = {}
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+
+    def _scan_class(self, cnode: ast.ClassDef) -> None:
+        cls = _ClassInfo(cnode.name, self.relpath)
+        self.classes[cnode.name] = cls
+        for stmt in cnode.body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                cls.method_names.add(stmt.name)
+        # pass 1: discover locks / events / queues / threads and
+        # inline ownership pragmas from every `self.X = ...`
+        for node in ast.walk(cnode):
+            if isinstance(node, ast.Assign):
+                targets = []
+                for t in node.targets:
+                    targets.extend(
+                        t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t])
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                targets = [node.target]
+            else:
+                continue
+            for tgt in targets:
+                a = _self_attr(tgt)
+                if a is None:
+                    continue
+                cls.assigned_attrs.add(a)
+                spec = self.spec_pragmas.get(node.lineno)
+                if spec is not None:
+                    cls.pragma_specs[a] = spec
+                if not isinstance(node.value, ast.Call):
+                    continue
+                canon = _canonical(self.imports, node.value.func)
+                if canon in ("threading.Lock", "threading.RLock"):
+                    cls.locks[a] = a
+                elif canon == "threading.Condition":
+                    arg = (node.value.args[0]
+                           if node.value.args else None)
+                    root = _self_attr(arg) if arg is not None else None
+                    cls.locks[a] = cls.locks.get(root, root) if root \
+                        else a
+                elif canon == "threading.Event":
+                    cls.events.add(a)
+                elif canon in ("queue.Queue", "queue.SimpleQueue",
+                               "queue.LifoQueue",
+                               "queue.PriorityQueue"):
+                    cls.queues.add(a)
+                elif canon == "threading.Thread":
+                    cls.threads.add(a)
+        # pass 2: walk method bodies
+        for stmt in cnode.body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                info = _FuncInfo(cls.name, stmt.name, self.relpath)
+                cls.funcs[stmt.name] = info
+                v = _FuncVisitor(cls, info, self.imports)
+                for s in stmt.body:
+                    v.visit(s)
+
+
+# --- package-wide analysis --------------------------------------------------
+
+
+def _lockid(cls: _ClassInfo, attr: str) -> tuple[str, str]:
+    return (cls.name, cls.locks.get(attr, attr))
+
+
+def _class_spec(cls: _ClassInfo, attr: str) -> tuple[str, Any] | None:
+    spec = OWNERSHIP.get(cls.name, {}).get(attr)
+    if spec is None:
+        spec = cls.pragma_specs.get(attr)
+    return spec
+
+
+def _locked_body_lock(cls: _ClassInfo, fname: str) -> str | None:
+    """The lock a helper's body is contractually holding, if any."""
+    base = fname.split(".")[-1]
+    declared = LOCKED_BODY_FUNCS.get((cls.name, base))
+    if declared is not None:
+        return cls.locks.get(declared, declared)
+    if base.endswith("_locked"):
+        roots = set(cls.locks.values())
+        if len(roots) == 1:
+            return next(iter(roots))
+        if roots:
+            return sorted(roots)[0]
+    return None
+
+
+class _Analysis:
+    def __init__(self, scans: list[_FileScan], strict: bool) -> None:
+        self.scans = scans
+        self.strict = strict
+        self.found: list[Violation] = []
+        # class name -> (_ClassInfo); later definition wins (fixture
+        # trees are small; the shipped class names are unique)
+        self.classes: dict[str, _ClassInfo] = {}
+        for sc in scans:
+            self.classes.update(sc.classes)
+        self.pragmas: dict[str, dict[int, set[str]]] = {
+            sc.relpath: sc.pragmas for sc in scans}
+        # node key: (class name, func name)
+        self.roles: dict[tuple[str, str], set[str]] = {}
+        self.edges: dict[tuple[str, str],
+                         list[tuple[tuple[str, str], int,
+                                    frozenset[str], str]]] = {}
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _emit(self, rule: str, relpath: str, lineno: int,
+              detail: str) -> None:
+        allowed = self.pragmas.get(relpath, {}).get(lineno, set())
+        if rule in allowed:
+            return
+        self.found.append(Violation(
+            "concurrency", rule, f"{relpath}:{lineno}", detail))
+
+    def _nodes(self):
+        for cls in self.classes.values():
+            for info in cls.funcs.values():
+                yield cls, info
+
+    # -- role graph --------------------------------------------------------
+
+    def _build_edges(self) -> None:
+        for cls, info in self._nodes():
+            key = (cls.name, info.name)
+            out = self.edges.setdefault(key, [])
+            for ref, ln, held in info.self_refs:
+                if ref in cls.funcs:
+                    out.append(((cls.name, ref), ln, held,
+                                cls.relpath))
+            for nm, ln, held in info.name_refs:
+                nested = f"{info.name}.{nm}"
+                if nested in cls.funcs:
+                    out.append(((cls.name, nested), ln, held,
+                                cls.relpath))
+            for attr, meth, ln, held in info.typed_calls:
+                for cand in ATTR_TYPES.get((cls.name, attr), ()):
+                    tc = self.classes.get(cand)
+                    if tc is not None and meth in tc.funcs:
+                        out.append(((cand, meth), ln, held,
+                                    cls.relpath))
+            for attr, ln, held in info.callable_refs:
+                for tgt in CALLABLE_ATTRS.get((cls.name, attr), ()):
+                    tc = self.classes.get(tgt[0])
+                    if tc is not None and tgt[1] in tc.funcs:
+                        out.append((tgt, ln, held, cls.relpath))
+
+    def _propagate_roles(self) -> None:
+        work: list[tuple[str, str]] = []
+
+        def seed(key: tuple[str, str], role: str) -> None:
+            got = self.roles.setdefault(key, set())
+            if role not in got:
+                got.add(role)
+                work.append(key)
+
+        for cls, info in self._nodes():
+            for tkey, role, _ln in info.spawns:
+                if tkey in cls.funcs:
+                    seed((cls.name, tkey), role)
+        for (relpath, qual), role in DECLARED_ENTRY_POINTS.items():
+            cname, _, fname = qual.partition(".")
+            cls = self.classes.get(cname)
+            if (cls is not None and cls.relpath == relpath
+                    and fname in cls.funcs):
+                seed((cname, fname), role)
+        while work:
+            key = work.pop()
+            for callee, _ln, _held, _rp in self.edges.get(key, ()):
+                for role in self.roles.get(key, ()):
+                    seed(callee, role)
+
+    def _node_roles(self, cls: _ClassInfo, fname: str) -> set[str]:
+        return {r for r in self.roles.get((cls.name, fname), set())
+                if r != "main"}
+
+    # -- rule: ownership / locking -----------------------------------------
+
+    def _checked(self, cls: _ClassInfo) -> bool:
+        return (cls.name in OWNERSHIP or bool(cls.pragma_specs)
+                or cls.spawns_threads)
+
+    def _check_attrs(self) -> None:
+        for cls in self.classes.values():
+            if not self._checked(cls):
+                continue
+            per_attr: dict[str, list[tuple[_FuncInfo, _Access]]] = {}
+            for info in cls.funcs.values():
+                for acc in info.accesses:
+                    per_attr.setdefault(acc.attr, []).append(
+                        (info, acc))
+            for attr, sites in per_attr.items():
+                spec = _class_spec(cls, attr)
+                if spec is None:
+                    self._check_undeclared(cls, attr, sites)
+                    continue
+                kind, data = spec
+                if kind == "handoff":
+                    continue
+                if kind == "role":
+                    self._check_role_attr(cls, attr, data, sites)
+                elif kind == "lock":
+                    self._check_lock_attr(cls, attr, data, sites)
+
+    def _check_role_attr(self, cls, attr, owners, sites) -> None:
+        owners = set(owners)
+        for info, acc in sites:
+            if not acc.write or acc.in_init:
+                continue
+            extra = self._node_roles(cls, info.name) - owners
+            if extra:
+                self._emit(
+                    "concurrency-nonowner-write", cls.relpath,
+                    acc.lineno,
+                    f"{cls.name}.{attr} is owned by role(s) "
+                    f"{'/'.join(sorted(owners))} but this write is "
+                    f"reachable from {'/'.join(sorted(extra))} "
+                    f"(via {info.name})")
+
+    def _check_lock_attr(self, cls, attr, lock, sites) -> None:
+        root = cls.locks.get(lock, lock)
+        for info, acc in sites:
+            if acc.in_init:
+                continue
+            if root in acc.held:
+                continue
+            if _locked_body_lock(cls, info.name) == root:
+                continue
+            self._emit(
+                "concurrency-unlocked-shared", cls.relpath,
+                acc.lineno,
+                f"{cls.name}.{attr} is guarded by {lock} but this "
+                f"{'write' if acc.write else 'read'} (in {info.name}) "
+                f"does not hold it")
+
+    def _check_undeclared(self, cls, attr, sites) -> None:
+        if attr in cls.locks or attr in cls.events \
+                or attr in cls.queues or attr in cls.threads:
+            return
+        if attr in cls.method_names:
+            return
+        roles: set[str] = set()
+        writes = []
+        non_init = []
+        for info, acc in sites:
+            if acc.in_init:
+                continue
+            non_init.append((info, acc))
+            roles |= self._node_roles(cls, info.name)
+            if acc.write:
+                writes.append((info, acc))
+        if len(roles) < 2 or not writes:
+            return
+        # a common lock held at EVERY non-init site makes it safe
+        common = None
+        for i, (info, acc) in enumerate(non_init):
+            held = set(acc.held)
+            body = _locked_body_lock(cls, info.name)
+            if body:
+                held.add(body)
+            common = held if common is None else (common & held)
+        if common:
+            return
+        info, acc = writes[0]
+        self._emit(
+            "concurrency-unlocked-shared", cls.relpath, acc.lineno,
+            f"{cls.name}.{attr} is accessed from roles "
+            f"{'/'.join(sorted(roles))} with no common lock and no "
+            f"OWNERSHIP declaration (declare an owner role, a "
+            f"guarding lock, or a handoff)")
+
+    # -- rule: lock order --------------------------------------------------
+
+    def _check_lock_order(self) -> None:
+        # transitively acquired locks per node (fixpoint over calls)
+        acquired: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        for cls, info in self._nodes():
+            key = (cls.name, info.name)
+            acquired[key] = {(cls.name, a)
+                             for a, _ln, _h in info.acquisitions}
+        changed = True
+        while changed:
+            changed = False
+            for key, outs in self.edges.items():
+                mine = acquired.setdefault(key, set())
+                for callee, _ln, _h, _rp in outs:
+                    extra = acquired.get(callee, set()) - mine
+                    if extra:
+                        mine |= extra
+                        changed = True
+        # edges with a witness site each
+        graph: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        sites: dict[tuple, tuple[str, int, str]] = {}
+
+        def add(a, b, relpath, ln, why):
+            graph.setdefault(a, set()).add(b)
+            sites.setdefault((a, b), (relpath, ln, why))
+
+        for cls, info in self._nodes():
+            for lock, ln, held in info.acquisitions:
+                b = (cls.name, lock)
+                for h in held:
+                    add((cls.name, h), b, cls.relpath, ln,
+                        f"{info.name} acquires {lock} while holding "
+                        f"{h}")
+        for key, outs in self.edges.items():
+            cname = key[0]
+            for callee, ln, held, relpath in outs:
+                if not held:
+                    continue
+                callee_cls = self.classes.get(callee[0])
+                body = (_locked_body_lock(callee_cls, callee[1])
+                        if callee_cls else None)
+                for b in acquired.get(callee, set()):
+                    if body is not None and b == (callee[0], body):
+                        continue  # caller-holds contract, not a grab
+                    for h in held:
+                        add((cname, h), b, relpath, ln,
+                            f"{key[1]} calls {callee[0]}.{callee[1]} "
+                            f"(acquires {b[1]}) while holding {h}")
+        # cycle detection (includes self-loops: non-reentrant locks)
+        state: dict[tuple[str, str], int] = {}
+        stack: list[tuple[str, str]] = []
+        reported: set[tuple] = set()
+
+        def dfs(n):
+            state[n] = 1
+            stack.append(n)
+            for m in graph.get(n, ()):
+                if m == n or state.get(m) == 1:
+                    i = stack.index(m) if m in stack else len(stack)
+                    cyc = stack[i:] + [m] if m != n else [n, n]
+                    for a, b in zip(cyc, cyc[1:]):
+                        if (a, b) in reported or (a, b) not in sites:
+                            continue
+                        reported.add((a, b))
+                        rp, ln, why = sites[(a, b)]
+                        names = " -> ".join(
+                            f"{c}.{l}" for c, l in cyc)
+                        self._emit("concurrency-lock-order", rp, ln,
+                                   f"lock-order cycle {names}: {why}")
+                elif m not in state:
+                    dfs(m)
+            stack.pop()
+            state[n] = 2
+
+        for n in list(graph):
+            if n not in state:
+                dfs(n)
+
+    # -- rule: blocking ----------------------------------------------------
+
+    def _check_blocking(self) -> None:
+        for cls, info in self._nodes():
+            fname = info.name.split(".")[-1]
+            pump = "serve-pump" in self.roles.get(
+                (cls.name, info.name), set())
+            for desc, ln, held, waitlock in info.blocking:
+                held_eff = set(held)
+                if waitlock is not None and waitlock in held_eff:
+                    # the CV pattern: wait() releases the condition
+                    held_eff.discard(waitlock)
+                held_eff -= {l for l in held_eff
+                             if (cls.name, l) in IO_LOCKS}
+                if held_eff:
+                    self._emit(
+                        "concurrency-blocking-under-lock",
+                        cls.relpath, ln,
+                        f"{desc} in {cls.name}.{info.name} while "
+                        f"holding {'/'.join(sorted(held_eff))}")
+                if (pump
+                        and cls.relpath
+                        not in PUMP_BLOCKING_EXEMPT_FILES
+                        and fname not in PUMP_BOUNDARY_FUNCS):
+                    self._emit(
+                        "concurrency-pump-blocking", cls.relpath, ln,
+                        f"{desc} in {cls.name}.{info.name} is "
+                        f"reachable from the serve-pump role outside "
+                        f"the harvest boundary")
+
+    # -- rule: locked-helper call sites ------------------------------------
+
+    def _check_locked_calls(self) -> None:
+        for cls, info in self._nodes():
+            for ref, ln, held in info.self_refs:
+                base = ref.split(".")[-1]
+                if not base.endswith("_locked"):
+                    continue
+                need = _locked_body_lock(cls, base)
+                if need is None or need in held:
+                    continue
+                self._emit(
+                    "concurrency-unlocked-shared", cls.relpath, ln,
+                    f"{cls.name}.{info.name} calls {ref} (a "
+                    f"caller-holds-{need} helper) without holding "
+                    f"{need}")
+
+    # -- strict (package) table/placement sync ------------------------------
+
+    def _check_strict(self) -> None:
+        for cname, attrs in OWNERSHIP.items():
+            cls = self.classes.get(cname)
+            if cls is None:
+                self._emit("concurrency-stale-ownership", "OWNERSHIP",
+                           0, f"class {cname} not found in package")
+                continue
+            touched = set(cls.assigned_attrs)
+            for info in cls.funcs.values():
+                touched |= {a.attr for a in info.accesses}
+            for attr in attrs:
+                if attr not in touched:
+                    self._emit(
+                        "concurrency-stale-ownership", cls.relpath, 0,
+                        f"OWNERSHIP declares {cname}.{attr} but no "
+                        f"method assigns it")
+        found: dict[tuple[str, str], tuple[tuple[str, ...], int]] = {}
+        for cls, info in self._nodes():
+            if info.assert_roles is not None:
+                found[(cls.relpath, f"{cls.name}.{info.name}")] = (
+                    info.assert_roles, info.assert_line)
+        for site, roles in RUNTIME_ASSERT_SITES.items():
+            got = found.pop(site, None)
+            if got is None:
+                self._emit(
+                    "concurrency-assert-placement", site[0], 0,
+                    f"RUNTIME_ASSERT_SITES expects assert_owner in "
+                    f"{site[1]} (roles {roles}) but none was found")
+            elif got[0] != roles:
+                self._emit(
+                    "concurrency-assert-placement", site[0], got[1],
+                    f"{site[1]} asserts roles {got[0]} but the table "
+                    f"declares {roles}")
+        for site, (roles, ln) in found.items():
+            self._emit(
+                "concurrency-assert-placement", site[0], ln,
+                f"assert_owner in {site[1]} (roles {roles}) is not "
+                f"declared in RUNTIME_ASSERT_SITES")
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> list[Violation]:
+        self._build_edges()
+        self._propagate_roles()
+        self._check_attrs()
+        self._check_locked_calls()
+        self._check_lock_order()
+        self._check_blocking()
+        if self.strict:
+            self._check_strict()
+        return self.found
+
+
+# --- entry points -----------------------------------------------------------
+
+
+def check_paths(root: pathlib.Path,
+                strict: bool = False) -> list[Violation]:
+    """Analyze every .py under `root` (relative-path scoping, like
+    `lint.lint_paths`). `strict` additionally verifies the OWNERSHIP
+    table and assert_owner placements against the tree - package scans
+    only (fixture trees don't carry the shipped classes)."""
+    global _last_scan_count
+    scans: list[_FileScan] = []
+    found: list[Violation] = []
+    n = 0
+    for path, rel in iter_package_files(root):
+        n += 1
+        source = path.read_text()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            found.append(
+                Violation("concurrency", "syntax", rel, str(e)))
+            continue
+        scans.append(_FileScan(rel, source, tree))
+    _last_scan_count = n
+    found.extend(_Analysis(scans, strict).run())
+    return found
+
+
+def check_package() -> list[Violation]:
+    import sparksched_tpu
+
+    root = pathlib.Path(sparksched_tpu.__file__).parent
+    return check_paths(root, strict=True)

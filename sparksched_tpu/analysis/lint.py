@@ -37,7 +37,12 @@ report use):
   p99 surprise. The file is a HOST_FILE (the generic host-sync rule
   deliberately exempts it — handing back concrete decisions IS its
   product), so this rule is the narrow replacement: syncs may live in
-  the harvest stage and the trace stamps, nowhere else.
+  the harvest stage and the trace stamps, nowhere else. ISSUE 19
+  generalizes this interprocedurally: `concurrency-pump-blocking`
+  (analysis/concurrency.py) follows the serve-pump ROLE through the
+  call graph package-wide, so a sync buried two calls deep or in a
+  different module is caught too; this rule stays as the cheap
+  file-scoped first line.
 
 Scoping is declarative data below. Known-host-side code is exempted
 there (visible in one place), and a line-level escape hatch exists for

@@ -45,6 +45,7 @@ import time
 from typing import Any, Callable
 
 from .metrics import MetricsRegistry, StreamingHistogram
+from ..ownership import assert_owner
 
 # per-decide latency source, in preference order: the device span is
 # the per-call latency proxy every traced front stamps
@@ -155,6 +156,7 @@ class FleetCollector:
         return self.scrape(now=t)
 
     def scrape(self, now: float | None = None) -> dict[str, Any]:
+        assert_owner(self, "serve-pump", "fleet-collector")
         t = self._clock() if now is None else float(now)
         self._last_scrape = t
         self.stats["collector_scrapes"] += 1

@@ -24,10 +24,15 @@ batch occupancy, linger waits, per-span latencies). Two exporters:
 - `snapshot()`: a JSON-safe dict (the JSONL exporter — write it
   through `RunLog.metrics`, one `metrics` record per snapshot).
 
-The registry is deliberately not thread-safe: the serving front is
-single-threaded by design (the SessionStore donation discipline), and
-a lock per counter bump on the request path is exactly the overhead
-the <=5% instrumentation bar forbids.
+The registry is thread-safe (ISSUE 19): one registry is bumped from
+the serve pump, the client worker threads, the online learner and the
+fleet collector, and scraped (snapshot/to_prometheus) concurrently —
+the bare dict read-modify-write in `counter()` lost increments under
+that load, and a snapshot iterating while a handler bumped could see
+a dict mutated mid-iteration. One registry-wide `threading.Lock`
+guards the three tables; an uncontended CPython lock acquire is
+~0.1us against ms-scale decides, so the <=5% instrumentation bar
+holds (measured: PERF.md round 21).
 
 `percentile_block` / `hist_summary` are the shared quantile helpers
 the benches use: `percentile_block` computes the EXACT sample
@@ -39,6 +44,7 @@ O(buckets) companion block (`hist`) new rows stamp alongside it.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Any, Iterable
 
 # default bucket geometry: growth 1.12 spans 1e-4 .. 1e7 (ms-scale
@@ -255,36 +261,51 @@ class MetricsRegistry:
     `metrics: MetricsRegistry | None` and skips on None."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
         self.hists: dict[str, StreamingHistogram] = {}
 
     def counter(self, name: str, inc: float = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + inc
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + inc
 
     def gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = float(value)
+        with self._lock:
+            self.gauges[name] = float(value)
 
     def observe(self, name: str, value: float) -> None:
-        h = self.hists.get(name)
-        if h is None:
-            h = self.hists[name] = StreamingHistogram()
-        h.add(value)
+        with self._lock:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = StreamingHistogram()
+            h.add(value)
 
     def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
         """Fold another registry in (counters add, gauges last-wins,
-        histograms merge) — the multi-worker aggregation path."""
-        for k, v in other.counters.items():
-            self.counter(k, v)
-        self.gauges.update(other.gauges)
-        for k, h in other.hists.items():
-            if k in self.hists:
-                self.hists[k].merge(h)
-            else:
-                mine = self.hists[k] = StreamingHistogram(
-                    h.lo, h.hi, h.growth
-                )
-                mine.merge(h)
+        histograms merge) — the multi-worker aggregation path.
+
+        The two locks are taken SEQUENTIALLY (copy out of `other`,
+        then fold into `self`), never nested — nesting two locks of
+        the same class is exactly the order-inversion shape the
+        concurrency pass forbids."""
+        with other._lock:
+            counters = dict(other.counters)
+            gauges = dict(other.gauges)
+            hists = []
+            for k, h in other.hists.items():
+                clone = StreamingHistogram(h.lo, h.hi, h.growth)
+                clone.merge(h)
+                hists.append((k, clone))
+        with self._lock:
+            for k, v in counters.items():
+                self.counters[k] = self.counters.get(k, 0) + v
+            self.gauges.update(gauges)
+            for k, clone in hists:
+                if k in self.hists:
+                    self.hists[k].merge(clone)
+                else:
+                    self.hists[k] = clone
         return self
 
     # -- exporters -----------------------------------------------------
@@ -292,13 +313,15 @@ class MetricsRegistry:
     def snapshot(self) -> dict[str, Any]:
         """JSON-safe dict — the JSONL exporter's payload (write via
         `RunLog.metrics`, one `metrics` record per snapshot)."""
-        return {
-            "counters": {k: self.counters[k]
-                         for k in sorted(self.counters)},
-            "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
-            "hists": {k: self.hists[k].summary()
-                      for k in sorted(self.hists)},
-        }
+        with self._lock:
+            return {
+                "counters": {k: self.counters[k]
+                             for k in sorted(self.counters)},
+                "gauges": {k: self.gauges[k]
+                           for k in sorted(self.gauges)},
+                "hists": {k: self.hists[k].summary()
+                          for k in sorted(self.hists)},
+            }
 
     def to_prometheus(self, prefix: str = "",
                       labels: dict[str, str] | None = None,
@@ -332,37 +355,38 @@ class MetricsRegistry:
             parts = ",".join(p for p in (lbl, extra) if p)
             return f"{n}{{{parts}}}" if parts else n
 
-        for k in sorted(self.counters):
-            n = _name(k)
-            if types:
-                lines.append(f"# TYPE {n} counter")
-            lines.append(f"{_series(n)} {self.counters[k]:g}")
-        for k in sorted(self.gauges):
-            n = _name(k)
-            if types:
-                lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{_series(n)} {self.gauges[k]:g}")
-        for k in sorted(self.hists):
-            h = self.hists[k]
-            n = _name(k)
-            if types:
-                lines.append(f"# TYPE {n} histogram")
-            cum = 0
-            # underflow's upper bound is `lo`, then every log-bucket
-            # edge; overflow folds into the +Inf line
-            for i in range(h.n + 1):
-                cum += h.counts[i]
-                le = h.lo if i == 0 else h._edge(i) * h.growth
-                edge = 'le="%g"' % le
+        with self._lock:
+            for k in sorted(self.counters):
+                n = _name(k)
+                if types:
+                    lines.append(f"# TYPE {n} counter")
+                lines.append(f"{_series(n)} {self.counters[k]:g}")
+            for k in sorted(self.gauges):
+                n = _name(k)
+                if types:
+                    lines.append(f"# TYPE {n} gauge")
+                lines.append(f"{_series(n)} {self.gauges[k]:g}")
+            for k in sorted(self.hists):
+                h = self.hists[k]
+                n = _name(k)
+                if types:
+                    lines.append(f"# TYPE {n} histogram")
+                cum = 0
+                # underflow's upper bound is `lo`, then every
+                # log-bucket edge; overflow folds into the +Inf line
+                for i in range(h.n + 1):
+                    cum += h.counts[i]
+                    le = h.lo if i == 0 else h._edge(i) * h.growth
+                    edge = 'le="%g"' % le
+                    lines.append(
+                        f"{_series(n + '_bucket', edge)} {cum}"
+                    )
+                inf_edge = 'le="+Inf"'
                 lines.append(
-                    f"{_series(n + '_bucket', edge)} {cum}"
+                    f"{_series(n + '_bucket', inf_edge)} {h.count}"
                 )
-            inf_edge = 'le="+Inf"'
-            lines.append(
-                f"{_series(n + '_bucket', inf_edge)} {h.count}"
-            )
-            lines.append(f"{_series(n + '_sum')} {h.total:g}")
-            lines.append(f"{_series(n + '_count')} {h.count}")
+                lines.append(f"{_series(n + '_sum')} {h.total:g}")
+                lines.append(f"{_series(n + '_count')} {h.count}")
         return "\n".join(lines) + "\n"
 
     def export_prometheus(self, path: str, prefix: str = "") -> None:

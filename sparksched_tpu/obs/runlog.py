@@ -185,7 +185,10 @@ class RunLog:
     # -- record writers ----------------------------------------------------
 
     def write(self, ev: str, **fields: Any) -> None:
-        if self._closed:
+        # double-checked fast path: a racy True is re-verified under
+        # the lock below; a racy False only skips a record on a log
+        # that is closing anyway
+        if self._closed:  # analysis: allow(concurrency-unlocked-shared)
             return
         rec = {"ev": ev, "t": round(time.time(), 3)}
         rec.update({k: _json_safe(v) for k, v in fields.items()})
@@ -361,7 +364,9 @@ class RunLog:
         _ACTIVE_RUNLOGS.add(self)
 
     def close(self, **fields: Any) -> None:
-        if self._closed:
+        # double-checked fast path (idempotent close): the
+        # authoritative check is write()'s locked re-test
+        if self._closed:  # analysis: allow(concurrency-unlocked-shared)
             return
         self.write("run_end", **fields)
         with self._lock:

@@ -37,6 +37,7 @@ import threading
 from typing import Any
 
 from ..obs.runlog import emit
+from ..ownership import assert_owner
 
 
 class ParamBus:
@@ -75,7 +76,12 @@ class ParamBus:
         }
 
     def _count(self, key: str, n: int = 1) -> None:
-        self.stats[key] += n
+        # stats is bumped from BOTH sides of the bus (publish on the
+        # learner thread, pump on the serving thread): the dict RMW
+        # goes under the bus lock — never call _count while already
+        # holding it (ISSUE 19; the lock is not reentrant)
+        with self._lock:
+            self.stats[key] += n
         if self.metrics is not None:
             self.metrics.counter(key, n)
 
@@ -85,10 +91,12 @@ class ParamBus:
         """Stage a version for the next pump. Latest wins: an unpumped
         older publish is dropped (counted) — serving always jumps to
         the freshest accepted params."""
+        assert_owner(self, "online-learner")
         with self._lock:
-            if self._pending is not None:
-                self._count("bus_skipped")
+            skipped = self._pending is not None
             self._pending = (params, int(version))
+        if skipped:
+            self._count("bus_skipped")
         self._count("bus_published")
 
     # -- serving side ---------------------------------------------------
@@ -98,6 +106,7 @@ class ParamBus:
         close out a finished probation window (rollback or prove),
         then apply any pending publish. Returns an event dict when
         something happened (swap / rollback / proven), else None."""
+        assert_owner(self, "serve-pump")
         event = self._pump()
         if event is not None and self.on_event is not None:
             self.on_event(event)

@@ -49,6 +49,7 @@ from ..obs.runlog import emit
 from ..trainers.ppo import PPO
 from ..trainers.rollout import Rollout, _zero_stored
 from .trajectory import Trajectory, TrajectoryBuffer
+from ..ownership import assert_owner
 
 # learner-trainer defaults: shorter epochs/batches than offline
 # training (online minibatches are small and frequent), the flagship
@@ -242,6 +243,7 @@ class OnlineLearner:
         the off-policy guard), pad, `ppo_update`, health-gate, and —
         accepted — publish the new version to the bus. Returns the
         step's info dict."""
+        assert_owner(self, "online-learner")
         trajs = self.buffer.drain(
             self.B, current_version=self.version,
             max_lag=self.max_param_lag,

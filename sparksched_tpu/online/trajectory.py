@@ -311,9 +311,16 @@ class TrajectoryBuffer:
     def requeue(self, trajs: list[Trajectory]) -> None:
         """Return drained trajectories to the completed queue (a
         learner that could not assemble a full batch puts them back;
-        the capacity bound still applies)."""
+        the capacity bound still applies).
+
+        Requeued trajectories go back to the FRONT (they were drained
+        from the front, so they are the oldest): if the pump filled
+        the buffer between drain and requeue, overflow eviction must
+        drop these STALE returns, not the fresh arrivals — appending
+        them at the tail inverted that and made `popleft` evict the
+        freshest data (ISSUE 19 race fix)."""
         with self._lock:
-            self._done.extend(trajs)
+            self._done.extendleft(reversed(trajs))
             while len(self._done) > self.capacity:
                 self._done.popleft()
                 self._count("online_dropped_overflow")

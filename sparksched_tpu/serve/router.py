@@ -54,6 +54,7 @@ from typing import Any
 import numpy as np
 
 from ..obs.runlog import emit
+from ..ownership import assert_owner
 from .session import (
     RemoteResult,
     SessionError,
@@ -738,6 +739,7 @@ class Router:
     # -- batching-front facade ---------------------------------------------
 
     def submit(self, gsid: int) -> RouterTicket:
+        assert_owner(self, "serve-pump", "fleet-collector")
         tk = RouterTicket(gsid)
         if gsid in self._failed:
             tk.error = ReplicaDied(
@@ -769,6 +771,7 @@ class Router:
         return len(self._tickets)
 
     def poll(self) -> bool:
+        assert_owner(self, "serve-pump", "fleet-collector")
         moved = self._drain()
         self._maybe_ring_pump()
         return moved

@@ -59,6 +59,7 @@ from typing import Any
 
 from ..config import SERVE_KEYS
 from ..obs.tracing import RequestTrace
+from ..ownership import assert_owner
 from .session import (
     RemoteResult,
     SessionError,
@@ -285,6 +286,7 @@ class ServeServer:
         op.event.set()
 
     def _handle_op(self, op: _Op, tracked: list) -> None:
+        assert_owner(self, "serve-pump")
         self._count("serve_http_requests")
         try:
             handler = {
@@ -356,7 +358,17 @@ class ServeServer:
             return
         self._inflight_by_tenant[tenant] = (
             self._inflight_by_tenant.get(tenant, 0) + 1)
-        tracked.append((op, self.front.submit(sid), tenant))
+        try:
+            tk = self.front.submit(sid)
+        except BaseException:
+            # a failed submit never became in-flight: release the
+            # quota slot or the tenant leaks budget permanently (the
+            # generic 500 handler knows nothing about the increment) —
+            # ISSUE 19 bookkeeping fix
+            self._inflight_by_tenant[tenant] = max(
+                0, self._inflight_by_tenant.get(tenant, 1) - 1)
+            raise
+        tracked.append((op, tk, tenant))
 
     def _finish_decide(self, op: _Op, tk, tenant: int) -> None:
         self._inflight_by_tenant[tenant] = max(

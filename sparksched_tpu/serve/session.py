@@ -154,6 +154,7 @@ from ..config import SERVE_KEYS, EnvParams
 from ..env import core
 from ..env.flat_loop import init_loop_state, take_slot, write_slot
 from ..obs.tracing import RequestTrace, annotate
+from ..ownership import assert_owner
 from ..workload.bank import WorkloadBank
 from .aot import (
     SERVE_KNOBS,
@@ -1184,6 +1185,7 @@ class SessionStore:
         OUTGOING version becomes the rollback target — pass False when
         re-publishing over a version still on probation
         (online.ParamBus does)."""
+        assert_owner(self, "serve-pump")
         new_l, new_def = jax.tree_util.tree_flatten(model_params)
         cur_l, cur_def = jax.tree_util.tree_flatten(self._model_params)
         mismatch = None
@@ -1267,6 +1269,7 @@ class SessionStore:
         """Reset a fresh episode into a free session; returns the
         session id (O(1) — maintained free-lists, no scan). Raises
         `RuntimeError` when the store is full."""
+        assert_owner(self, "serve-pump")
         if not self._free_sids:
             self.stats["serve_capacity_rejections"] += 1
             if self.metrics is not None:
@@ -1306,6 +1309,7 @@ class SessionStore:
         return sid
 
     def close(self, sid: int) -> None:
+        assert_owner(self, "serve-pump")
         self._check_sid(sid, allow_quarantined=True)
         if self.collector is not None or (
             self._ring_on and self.ring_sink is not None
@@ -1399,6 +1403,7 @@ class SessionStore:
 
     def decide(self, sid: int) -> ServeResult:
         """One policy decision on the unbatched AOT path."""
+        assert_owner(self, "serve-pump")
         self._check_sid(sid)
         [slot] = self._ensure_hot([sid])
         g, l = divmod(slot, self.group_slots)
@@ -1468,6 +1473,7 @@ class SessionStore:
         for a lone request). All results of one call share one
         `params_version` — the params are a single argument of the
         compiled program, so a swap can never tear mid-batch."""
+        assert_owner(self, "serve-pump")
         if not sids:
             return []
         if len(sids) > self.max_batch:
@@ -1498,7 +1504,10 @@ class SessionStore:
     @property
     def inflight(self) -> int:
         """Dispatched-but-unharvested compiled calls."""
-        return len(self._inflight)
+        # the deque is shared with the optional harvester: reads take
+        # the condition too (uncontended: one lock op, ~100ns)
+        with self._harvest_cv:
+            return len(self._inflight)
 
     def dispatch_batch(self, sids: list[int]) -> InFlightCall:
         """The asynchronous half of `decide_batch`: validate, page the
@@ -1511,6 +1520,7 @@ class SessionStore:
         same sequence of decide_batch calls (same admission order =>
         same fold_in keys => same compiled computation); only WHEN the
         host observes them moves."""
+        assert_owner(self, "serve-pump")
         if not sids:
             raise ValueError("empty batch")
         if len(sids) > self.max_batch:
@@ -1615,9 +1625,9 @@ class SessionStore:
             if call.spans is not None:
                 call.spans["scatter_back"] = time.perf_counter()
             if self.metrics is not None:
-                self.metrics.gauge(
-                    "serve_inflight_depth", len(self._inflight)
-                )
+                with self._harvest_cv:
+                    depth = len(self._inflight)
+                self.metrics.gauge("serve_inflight_depth", depth)
             done.append(call)
         return done
 
@@ -1664,7 +1674,9 @@ class SessionStore:
         done = self.pop_ready(wait=wait, limit=limit)
         for call in done:
             self.finalize_call(call)
-        idle = wait and not self._inflight
+        with self._harvest_cv:
+            empty = not self._inflight
+        idle = wait and empty
         self._drain_writebacks(wait=idle)
         # harvest-idle is a ring-drain boundary (ISSUE 18): with the
         # in-flight window empty there is no dispatch to protect, so
@@ -1850,6 +1862,7 @@ class MicroBatcher:
         self._pending: list[Ticket] = []
 
     def submit(self, sid: int) -> Ticket:
+        assert_owner(self, "serve-pump")
         t = Ticket(sid, traced=self.trace)
         self._pending.append(t)
         if len(self._pending) >= self.store.max_batch:
@@ -1884,6 +1897,7 @@ class MicroBatcher:
         be served (quarantined / closed session) fails its OWN ticket
         via `Ticket.error`; the rest of the batch is still served —
         no ticket is ever left unresolved."""
+        assert_owner(self, "serve-pump")
         m = self.metrics
         first = True
         while self._pending:
@@ -2039,6 +2053,7 @@ class ContinuousBatcher:
         self._skips: dict[int, int] = {}
 
     def submit(self, sid: int) -> Ticket:
+        assert_owner(self, "serve-pump")
         t = Ticket(sid, traced=self.trace)
         q = self._queues.get(sid)
         if q is None:
@@ -2268,6 +2283,7 @@ class ContinuousBatcher:
         compiled call — synchronously at `depth=1`, as a dispatched
         in-flight call under pipelining (tickets resolve at harvest);
         True when a batch ran."""
+        assert_owner(self, "serve-pump")
         ripe: list = []
         if self.depth > 1:
             # the pipelined pump NEVER blocks: the caller's loop work

@@ -84,10 +84,11 @@ def test_cli_json_and_exit_code():
     """The CLI contract: JSON on stdout, exit 0 on a clean tree. Runs
     the cheap passes only — the full jaxpr audit already runs
     in-process above, and a subprocess re-trace would double tier-1's
-    trace bill for no new signal."""
+    trace bill for no new signal. The AST passes (lint, coverage,
+    concurrency) are all cheap, so the gate runs all three."""
     r = subprocess.run(
         [sys.executable, "-m", "sparksched_tpu.analysis",
-         "--passes", "lint,contracts", "--quiet"],
+         "--passes", "lint,coverage,concurrency,contracts", "--quiet"],
         capture_output=True, timeout=600,
         cwd=pathlib.Path(__file__).resolve().parent.parent,
     )
@@ -623,3 +624,239 @@ def test_core_engine_500_steps_contract_invariant(small_env):
 
     assert check_telemetry(tm) == []
     assert int(tm.decide_steps) > 0
+
+
+# ---------------------------------------------------------------------------
+# coverage rules: seeded violations (ISSUE 19 — every jit/AOT site is
+# registered in the jaxpr-audit registry or explicitly waived)
+# ---------------------------------------------------------------------------
+
+
+def _coverage_tree(tmp_path, files: dict[str, str]):
+    from sparksched_tpu.analysis import coverage
+
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return coverage.check_paths(root)
+
+
+def test_rule_unregistered_jit_fires_and_pragma_clears(tmp_path):
+    src = {"env/hot.py": """\
+        import jax
+
+        @jax.jit
+        def fast(x):
+            return x + 1
+
+        def build():
+            return jax.jit(lambda x: x * 2)
+    """}
+    vs = _coverage_tree(tmp_path, src)
+    got = [v for v in vs if v.rule == "coverage-unregistered-jit"]
+    # both forms: the decorator AND the call expression
+    assert len(got) == 2
+    assert {v.where for v in got} == {"env/hot.py:3", "env/hot.py:8"}
+    vs2 = _coverage_tree(tmp_path, {"env/hot.py": """\
+        import jax
+
+        @jax.jit  # analysis: allow(coverage-unregistered-jit)
+        def fast(x):
+            return x + 1
+
+        def build():
+            return jax.jit(lambda x: x * 2)  # analysis: allow(coverage-unregistered-jit)
+    """})
+    assert _rules(vs2) == set()
+
+
+def test_coverage_table_matches_shipped_tree():
+    """Strict mode on the real package: zero unregistered sites, zero
+    stale entries, and every registered program name exists in the
+    jaxpr-audit BUDGETS (the three tables cannot drift apart)."""
+    from sparksched_tpu.analysis import coverage
+
+    assert coverage.check_package() == []
+    assert coverage.last_scan_count() > 30
+
+
+# ---------------------------------------------------------------------------
+# concurrency rules: seeded violations (ISSUE 19 — fixture trees mirror
+# the package layout; roles seed from the Thread spawn's name=)
+# ---------------------------------------------------------------------------
+
+
+def _conc_tree(tmp_path, files: dict[str, str]):
+    from sparksched_tpu.analysis import concurrency
+
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return concurrency.check_paths(root)
+
+
+def test_rule_nonowner_write_fires_and_pragma_clears(tmp_path):
+    src = """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.data = {}  # owner: serve-pump
+                self._t = threading.Thread(
+                    target=self._loop, name="online-learner"
+                )
+
+            def _loop(self):
+                self.data["k"] = 1PRAGMA
+
+            def pump(self):
+                self.data["k"] = 2
+    """
+    vs = _conc_tree(
+        tmp_path, {"serve/pump.py": src.replace("PRAGMA", "")})
+    got = [v for v in vs if v.rule == "concurrency-nonowner-write"]
+    # only the learner-thread write fires; the role-less method (main
+    # is ownership-polymorphic) is fine
+    assert [v.where for v in got] == ["serve/pump.py:11"]
+    assert "online-learner" in got[0].detail
+    vs2 = _conc_tree(tmp_path, {"serve/pump.py": src.replace(
+        "PRAGMA",
+        "  # analysis: allow(concurrency-nonowner-write)")})
+    assert _rules(vs2) == set()
+
+
+def test_rule_unlocked_shared_fires_and_pragma_clears(tmp_path):
+    src = """\
+        import threading
+
+        class Buf:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # lock: _lock
+
+            def add(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+            def bad(self):
+                return len(self.items){pragma}
+    """
+    vs = _conc_tree(tmp_path, {"serve/buf.py": src.format(pragma="")})
+    got = [v for v in vs if v.rule == "concurrency-unlocked-shared"]
+    assert [v.where for v in got] == ["serve/buf.py:13"]
+    vs2 = _conc_tree(tmp_path, {"serve/buf.py": src.format(
+        pragma="  # analysis: allow(concurrency-unlocked-shared)")})
+    assert _rules(vs2) == set()
+
+
+def test_rule_lock_order_fires_and_pragma_clears(tmp_path):
+    src = """\
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:{p1}
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:{p2}
+                        pass
+    """
+    vs = _conc_tree(tmp_path, {"serve/ab.py": src.format(p1="", p2="")})
+    got = [v for v in vs if v.rule == "concurrency-lock-order"]
+    # the cycle is reported at each edge's acquisition site
+    assert {v.where for v in got} == {"serve/ab.py:10", "serve/ab.py:15"}
+    allow = "  # analysis: allow(concurrency-lock-order)"
+    vs2 = _conc_tree(tmp_path, {"serve/ab.py": src.format(
+        p1=allow, p2=allow)})
+    assert _rules(vs2) == set()
+    # waiving ONE edge leaves the other firing — the pragma is
+    # per-site, never per-cycle
+    vs3 = _conc_tree(tmp_path, {"serve/ab.py": src.format(
+        p1=allow, p2="")})
+    assert [v.where for v in vs3
+            if v.rule == "concurrency-lock-order"] == ["serve/ab.py:15"]
+
+
+def test_rule_blocking_under_lock_fires_and_pragma_clears(tmp_path):
+    src = """\
+        import queue
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad(self):
+                with self._lock:
+                    return self._q.get(){pragma}
+
+            def ok(self):
+                with self._lock:
+                    return self._q.get(timeout=1.0)
+    """
+    vs = _conc_tree(tmp_path, {"serve/w.py": src.format(pragma="")})
+    got = [v for v in vs if v.rule == "concurrency-blocking-under-lock"]
+    # the bounded get (timeout=) never fires
+    assert [v.where for v in got] == ["serve/w.py:11"]
+    vs2 = _conc_tree(tmp_path, {"serve/w.py": src.format(
+        pragma="  # analysis: allow(concurrency-blocking-under-lock)")})
+    assert _rules(vs2) == set()
+
+
+def test_rule_pump_blocking_fires_and_pragma_clears(tmp_path):
+    src = """\
+        import threading
+
+        import jax
+
+        class Pump:
+            def __init__(self):
+                self._t = threading.Thread(
+                    target=self._pump, name="serve-pump"
+                )
+
+            def _pump(self):
+                jax.block_until_ready(1){pragma}
+                self.harvest()
+
+            def harvest(self):
+                jax.block_until_ready(2)
+    """
+    vs = _conc_tree(tmp_path, {"serve/loop.py": src.format(pragma="")})
+    got = [v for v in vs if v.rule == "concurrency-pump-blocking"]
+    # only the sync OUTSIDE the harvest boundary fires: harvest() is a
+    # sanctioned blocking stage even though the pump role reaches it
+    assert [v.where for v in got] == ["serve/loop.py:12"]
+    vs2 = _conc_tree(tmp_path, {"serve/loop.py": src.format(
+        pragma="  # analysis: allow(concurrency-pump-blocking)")})
+    assert _rules(vs2) == set()
+
+
+def test_assert_placement_table_matches_code_and_runtime():
+    """The three layers cannot drift: the static RUNTIME_ASSERT_SITES
+    table, the assert_owner calls in source (strict scan fails on any
+    mismatch, either direction), and the runtime role names."""
+    from sparksched_tpu import ownership
+    from sparksched_tpu.analysis import concurrency
+
+    assert concurrency.check_package() == []
+    assert concurrency.last_scan_count() > 30
+    exp = concurrency.runtime_assert_expectations()
+    assert len(exp) >= 15
+    roles = {r for rs in exp.values() for r in rs}
+    # every asserted role is a spawnable role the runtime knows; main
+    # is ownership-polymorphic and never asserted
+    assert roles <= set(concurrency.KNOWN_ROLES) - {"main"}
+    assert ownership.ENV_FLAG == "SPARKSCHED_DEBUG_OWNERSHIP"
