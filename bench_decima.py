@@ -597,7 +597,8 @@ def bench_serve_latency(
         }
 
     def emit(metric: str, samples_ms: list[float], cfg_extra: dict,
-             wall_split: dict | None = None) -> None:
+             wall_split: dict | None = None,
+             attribution: dict | None = None) -> None:
         from sparksched_tpu.obs.metrics import hist_summary
 
         lat = _latency_block(samples_ms, len(samples_ms)) | cold
@@ -620,6 +621,8 @@ def bench_serve_latency(
             "config": base_cfg | cfg_extra,
             "on_chip": _on_chip_block(),
         }
+        if attribution is not None:
+            row["attribution"] = attribution
         rows.append(row)
         runlog.latency(lat, batch=cfg_extra.get("batch"), metric=metric)
         _emit_row(row)
@@ -780,6 +783,55 @@ def bench_serve_latency(
             {"batch": 1, "linger_ms": linger_ms, "front": "batcher"},
             wall_split=wall_split_block(ws0, len(samples)),
         )
+
+    # --- ISSUE 20: attribution capture. A SEPARATE short window (the
+    # ledger-pinned linger rows above stay untraced, their timing
+    # untouched): the lone-request shape through a traced front
+    # carrying the critical-path analyzer, emitting one row whose
+    # `attribution` block decomposes the wall into segments
+    # (ledger-indexed as serve_latency_attribution_seg_*_p99_ms) ---
+    from sparksched_tpu.obs.critpath import CritPathAnalyzer
+    from sparksched_tpu.obs.metrics import MetricsRegistry
+
+    att_reg = MetricsRegistry()
+    att_cp = CritPathAnalyzer(metrics=att_reg, window_s=float("inf"))
+    store.metrics, store.trace = att_reg, True
+    mb = MicroBatcher(store, linger_ms=0.0, metrics=att_reg,
+                      trace=True, critpath=att_cp)
+    lone = store.create(seed=6000)
+    samples = []
+    for i in range(max(10, reps // 5)):
+        tk = mb.submit(lone)
+        while not tk.ready:
+            mb.poll()
+        samples.append(
+            (time.perf_counter() - tk.submitted_at) * 1e3
+        )
+        if (tk.result is None or tk.result.done
+                or tk.result.health_mask):
+            store.close(lone)
+            lone = store.create(seed=6100 + i)
+    store.close(lone)
+    store.metrics, store.trace = None, False
+    att_snap = att_cp.snapshot()
+    att_hists = att_reg.snapshot()["hists"]
+    emit(
+        "serve_latency_attribution", samples,
+        {"batch": 1, "front": "batcher", "attribution": True},
+        attribution={
+            "seg_p99_ms": {
+                k.removeprefix("serve_seg_").removesuffix("_ms"):
+                    v["p99"]
+                for k, v in att_hists.items()
+                if k.startswith("serve_seg_")
+            },
+            "dominant_tail_segment": att_snap.get(
+                "dominant_tail_segment"
+            ),
+            "at_p50": att_snap.get("at_p50"),
+            "at_p99": att_snap.get("at_p99"),
+        },
+    )
 
     os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
     with open(artifact, "w") as fp:
@@ -1086,26 +1138,34 @@ def bench_serve_scale(
 
     def one_run(rate, process, front):
         """One open-loop run of the seeded schedule through `front`;
-        returns (summary, samples, hist, metrics snapshot)."""
+        returns (summary, samples, hist, metrics snapshot,
+        attribution snapshot)."""
+        from sparksched_tpu.obs.critpath import CritPathAnalyzer
+
         arrivals = generate_arrivals(
             rate, n_req, tenants, process=process, seed=seed
         )
         reg = MetricsRegistry()
+        # ISSUE 20: the attribution plane rides every traced arm —
+        # per-segment hists land in `reg`, the joint quantile mixes
+        # in the snapshot (window disabled: the run IS the window)
+        cp = CritPathAnalyzer(metrics=reg, window_s=float("inf"))
         st = store_pipe if front == "pipelined" else store
         st.metrics, st.trace = reg, True
         if front == "pipelined":
             b = ContinuousBatcher(
                 st, depth=depth, metrics=reg, runlog=runlog,
-                trace=True,
+                trace=True, critpath=cp,
             )
         elif front == "continuous":
             b = ContinuousBatcher(
-                st, metrics=reg, runlog=runlog, trace=True
+                st, metrics=reg, runlog=runlog, trace=True,
+                critpath=cp,
             )
         else:
             b = MicroBatcher(
                 st, linger_ms=linger_ms, metrics=reg,
-                runlog=runlog, trace=True,
+                runlog=runlog, trace=True, critpath=cp,
             )
         summary = run_open_loop(
             st, b, arrivals, slo_ms=slo_ms,
@@ -1114,7 +1174,7 @@ def bench_serve_scale(
         st.metrics, st.trace = None, False
         samples = summary.pop("samples_ms")
         hist = summary.pop("hist")
-        return summary, samples, hist, reg.snapshot()
+        return summary, samples, hist, reg.snapshot(), cp.snapshot()
 
     for rate, process in points:
         # interleaved arms, rep-by-rep (the PR-11 interleaved_ab
@@ -1133,7 +1193,9 @@ def bench_serve_scale(
             ]
             # the row is the MEDIAN-goodput rep's full summary
             order = sorted(range(len(reps)), key=goodputs.__getitem__)
-            summary, samples, hist, snap = reps[order[len(order) // 2]]
+            summary, samples, hist, snap, att = (
+                reps[order[len(order) // 2]]
+            )
             lat_block = percentile_block(samples)
             med_p99 = sorted(p99s)[len(p99s) // 2]
             if process == "poisson":
@@ -1191,6 +1253,23 @@ def bench_serve_scale(
                 "trace": {
                     k: v for k, v in snap["hists"].items()
                     if k.startswith("serve_span_")
+                },
+                # ISSUE 20: the attribution stamp — windowed
+                # per-segment p99s (ledger-indexed as
+                # `<metric>_seg_<seg>_p99_ms`) plus the joint segment
+                # mix at p50 vs p99 and the dominant tail segment
+                "attribution": {
+                    "seg_p99_ms": {
+                        k.removeprefix("serve_seg_")
+                         .removesuffix("_ms"): v["p99"]
+                        for k, v in snap["hists"].items()
+                        if k.startswith("serve_seg_")
+                    },
+                    "dominant_tail_segment": att.get(
+                        "dominant_tail_segment"
+                    ),
+                    "at_p50": att.get("at_p50"),
+                    "at_p99": att.get("at_p99"),
                 },
                 # the metrics stamp: admission/occupancy views +
                 # counters (wait_ms is the linger wait under the

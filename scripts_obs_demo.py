@@ -26,7 +26,11 @@ writes `artifacts/runlog/obs_demo.jsonl`:
    windows with a `FleetCollector` + burn-rate `SLOMonitor` scraping
    on EVERY window (`period_s=0` — the worst case; production scrapes
    once per second) vs no collector, isolating the collector/SLO cost
-   from the serve instrumentation cost measured in 5, same bar.
+   from the serve instrumentation cost measured in 5, same bar;
+7. A/B-times the TAIL-ATTRIBUTION plane (ISSUE 20): the same traced
+   flush windows with a `CritPathAnalyzer` consuming every ticket and
+   a `HostProfiler` sampling in the background vs traced-but-bare,
+   isolating the attribution cost from the tracing cost, same bar.
 
 The task-duration sampler is pinned to a deterministic table lookup for
 the parity section (the two engines draw from legitimately different
@@ -392,6 +396,85 @@ def fleet_overhead_section(log: RunLog, store) -> float:
     return pct if n_alerts == 0 else 100.0
 
 
+def attribution_overhead_section(log: RunLog, store) -> float:
+    """ISSUE 20: the tail-attribution A/B. Both arms run fully TRACED
+    flush windows (per-request span stamps on, so the tracing cost —
+    already measured by the serve section — cancels); the `on` arm
+    additionally feeds every finished ticket through a
+    `CritPathAnalyzer` (critical-path decomposition + windowed segment
+    histograms + slowest-N exemplar reservoir) while a `HostProfiler`
+    samples thread stacks at its stock rate in the background. That is
+    the entire round-20 plane: a <5% per-window verdict here bounds
+    what `attribution: true` costs the serve path. Reuses the warm AOT
+    store (no second compile)."""
+    from sparksched_tpu.obs.critpath import CritPathAnalyzer
+    from sparksched_tpu.obs.hostprof import HostProfiler
+    from sparksched_tpu.obs.metrics import (
+        MetricsRegistry,
+        interleaved_ab,
+    )
+    from sparksched_tpu.serve import MicroBatcher
+
+    def same_group_sessions(base: int) -> list[int]:
+        cand = [store.create(seed=base + i)
+                for i in range(2 * store.max_batch)]
+        g0 = store.session_group(cand[0])
+        keep = [s for s in cand
+                if store.session_group(s) == g0][: store.max_batch]
+        for s in cand:
+            if s not in keep:
+                store.close(s)
+        return keep
+
+    sids = same_group_sessions(8000)
+    store.metrics, store.trace = MetricsRegistry(), True
+    cp = CritPathAnalyzer(metrics=store.metrics, window_s=1e9)
+    mb_off = MicroBatcher(store, linger_ms=1e6, metrics=store.metrics,
+                          trace=True)
+    mb_on = MicroBatcher(store, linger_ms=1e6, metrics=store.metrics,
+                         trace=True, critpath=cp)
+    prof = HostProfiler().start()
+
+    def window(mb) -> float:
+        t0 = time.perf_counter()
+        tks = [mb.submit(s) for s in sids]  # full batch => auto-flush
+        dt = time.perf_counter() - t0
+        results = [t.result for t in tks if t.result is not None]
+        if any(r.done or r.health_mask for r in results):
+            for s in sids:
+                store.close(s)
+            sids[:] = same_group_sessions(8500)
+        return dt
+
+    t_off, t_on, pct = interleaved_ab(
+        lambda: window(mb_off), lambda: window(mb_on),
+        warmups=2, reps=5,
+    )
+    tables = prof.stop(emit=False)
+    snap = cp.snapshot()
+    emit(f"attribution at p99 (joint window): "
+         f"{(snap.get('at_p99') or {}).get('share')}")
+    roles = ", ".join(
+        f"{r}={v['share']:.2f}" for r, v in
+        list(tables.get("roles", {}).items())[:3]
+    ) or "n/a"
+    emit(f"host profile ({tables.get('samples', 0)} samples @ "
+         f"{tables.get('hz')} Hz): {roles}")
+    emit(f"tail attribution per-window ({store.max_batch}-wide traced "
+         f"windows, critpath+hostprof on): off {t_off*1e3:.2f} ms, on "
+         f"{t_on*1e3:.2f} ms -> overhead {pct:+.2f}% "
+         f"({'PASS' if pct < 5.0 else 'FAIL'}, bar: <5%)")
+    log.write("attribution_overhead", off_ms=round(t_off * 1e3, 4),
+              on_ms=round(t_on * 1e3, 4), overhead_pct=round(pct, 2),
+              requests=cp.stats["critpath_requests"],
+              hostprof_samples=tables.get("samples", 0),
+              passed=pct < 5.0)
+    for s in sids:
+        store.close(s)
+    store.metrics, store.trace = None, False
+    return pct
+
+
 def main() -> int:
     import contextlib
     import os
@@ -407,7 +490,8 @@ def main() -> int:
     pct = overhead_section(log)
     if os.environ.get("OBS_DEMO_SERVE", "1") == "1":
         serve_pct, store = serve_overhead_section(log)
-        pct = max(pct, serve_pct, fleet_overhead_section(log, store))
+        pct = max(pct, serve_pct, fleet_overhead_section(log, store),
+                  attribution_overhead_section(log, store))
     log.close(parity_ok=ok, overhead_pct=round(pct, 2))
     emit(f"runlog written: {log.path}")
     return 0 if ok and pct < 5.0 else 1

@@ -94,6 +94,7 @@ KNOWN_ROLES = (
     "serve-client",
     "online-learner",
     "fleet-collector",
+    "host-profiler",
 )
 
 # Threads the stdlib spawns for us: ThreadingHTTPServer's handler pool
@@ -139,6 +140,11 @@ ATTR_TYPES: dict[tuple[str, str], tuple[str, ...]] = {
     ("FleetCollector", "runlog"): ("RunLog",),
     ("ServeClient", "metrics"): ("MetricsRegistry",),
     ("ServeClient", "runlog"): ("RunLog",),
+    ("MicroBatcher", "critpath"): ("CritPathAnalyzer",),
+    ("ContinuousBatcher", "critpath"): ("CritPathAnalyzer",),
+    ("FleetCollector", "critpath"): ("CritPathAnalyzer",),
+    ("ServeServer", "hostprof"): ("HostProfiler",),
+    ("OnlineLearner", "hostprof"): ("HostProfiler",),
 }
 
 # Plain-callable attributes (bound methods injected by composition
@@ -265,6 +271,29 @@ OWNERSHIP: dict[str, dict[str, tuple[str, Any]]] = {
         "last_status": ("role", ("serve-pump", "fleet-collector")),
         "stats": ("role", ("serve-pump", "fleet-collector")),
     },
+    "CritPathAnalyzer": {
+        # ingest path (observe/add) rides the serve pump that finishes
+        # tickets; the exemplar-window flush path additionally rides
+        # the fleet collector's scrape (idle-tail shipping) — the same
+        # mutually-exclusive-drivers contract as FleetCollector
+        "profile": ("role", ("serve-pump",)),
+        "by_tenant": ("role", ("serve-pump",)),
+        "by_replica": ("role", ("serve-pump",)),
+        "_seq": ("role", ("serve-pump",)),
+        "_exemplars": ("role", ("serve-pump", "fleet-collector")),
+        "_window_start": ("role", ("serve-pump", "fleet-collector")),
+        "stats": ("role", ("serve-pump", "fleet-collector")),
+    },
+    "HostProfiler": {
+        # sample tables are sampler-thread-owned; start/stop (which
+        # touch _started_at/_elapsed_s) run on the constructing main
+        # thread before spawn / after join — happens-before ordered
+        "_counts": ("role", ("host-profiler",)),
+        "_samples": ("role", ("host-profiler",)),
+        "_elapsed_s": ("role", ("host-profiler",)),
+        "_started_at": ("role", ("host-profiler",)),
+        "_stop": ("handoff", "threading.Event"),
+    },
     "MetricsRegistry": {
         # shared by every role that instruments (pump, client workers,
         # learner, collector): the one registry-wide lock (ISSUE 19
@@ -332,6 +361,8 @@ RUNTIME_ASSERT_SITES: dict[tuple[str, str], tuple[str, ...]] = {
     ("online/learner.py", "OnlineLearner.step"): ("online-learner",),
     ("obs/fleet.py", "FleetCollector.scrape"):
         ("serve-pump", "fleet-collector"),
+    ("obs/critpath.py", "CritPathAnalyzer.add"): ("serve-pump",),
+    ("obs/hostprof.py", "HostProfiler._sample"): ("host-profiler",),
 }
 
 # mutating container methods: a call `self.<attr>.<m>(...)` with m in

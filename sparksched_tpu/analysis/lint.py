@@ -101,6 +101,11 @@ HOST_FILES = frozenset({
     # product); nothing in them traces. Already under the obs/
     # sync-exempt dir; named here so the host scoping is explicit.
     "obs/fleet.py", "obs/slo.py", "obs/ledger.py",
+    # ISSUE 20: the tail-attribution plane — span arithmetic over
+    # perf_counter stamps and a wall-clock sampling profiler are host
+    # instruments by definition (the clock IS the measurement).
+    # Already under the obs/ sync-exempt dir; named for visibility.
+    "obs/critpath.py", "obs/hostprof.py",
 })
 
 # host-side entry points inside otherwise-hot modules, PATH-QUALIFIED

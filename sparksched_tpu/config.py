@@ -183,6 +183,12 @@ SERVE_KEYS = frozenset({
     "slo",  # nested declarative SLO block (obs.slo.SLO_CONFIG_KEYS:
     #   p99_ms, goodput_floor_rps, quarantine_rate_max, max_staleness,
     #   windows, rollback_on, cooldown_s, min_events)
+    # ISSUE 20: the tail-latency attribution plane — a front-level
+    # knob like `front:`/`linger_ms` (consumed by `front_from_config`,
+    # ignored by `store_from_config`). Defaults to the `trace` value:
+    # traced serving gets attribution unless explicitly disabled.
+    "attribution",  # critical-path analyzer + tail exemplars on the front
+    "hostprof",  # role-attributed sampling profiler over the serve threads
 })
 
 ONLINE_KEYS = frozenset({

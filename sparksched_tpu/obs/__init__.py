@@ -43,8 +43,6 @@ Three parts (ISSUE 2 tentpole), each usable on its own:
   (`python -m sparksched_tpu.obs.ledger`, the tier-1 gate).
 """
 
-from .fleet import FleetCollector, labeled_prometheus  # noqa: F401
-from .ledger import Ledger  # noqa: F401
 from .memory import device_memory_stats, lane_fit  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
@@ -61,3 +59,38 @@ from .slo import (  # noqa: F401
 )
 from .telemetry import Telemetry, summarize, telemetry_zeros  # noqa: F401
 from .tracing import RequestTrace, annotate  # noqa: F401
+
+# PEP 562 lazy imports for the submodules that double as CLIs
+# (`python -m sparksched_tpu.obs.{fleet,ledger}`) or that only the
+# serving/attribution path needs: importing them eagerly here put the
+# module object in sys.modules before runpy re-imported it, tripping
+# the "found in sys.modules after import of package" RuntimeWarning
+# (ISSUE 20 satellite). Consumers import these symbols or the
+# submodules directly; both resolve identically through __getattr__.
+_LAZY = {
+    "FleetCollector": ("fleet", "FleetCollector"),
+    "labeled_prometheus": ("fleet", "labeled_prometheus"),
+    "Ledger": ("ledger", "Ledger"),
+    "CritPathAnalyzer": ("critpath", "CritPathAnalyzer"),
+    "SegmentProfile": ("critpath", "SegmentProfile"),
+    "decompose": ("critpath", "decompose"),
+    "HostProfiler": ("hostprof", "HostProfiler"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(
+        importlib.import_module(f".{mod_name}", __name__), attr
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -44,6 +44,7 @@ import json
 import time
 from typing import Any, Callable
 
+from .critpath import SEG_HIST
 from .metrics import MetricsRegistry, StreamingHistogram
 from ..ownership import assert_owner
 
@@ -61,6 +62,10 @@ _SCOREBOARD_FIELDS = (
     # overrun drops (nonzero = the drain cadence can't keep up with
     # this replica's decision rate)
     "ring_occ", "ring_drains", "ring_dropped",
+    # ISSUE 20: the replica's dominant tail segment over the scrape
+    # window (argmax of the windowed per-segment p99s — which stage
+    # of the request path owns THIS replica's tail right now)
+    "tail_seg",
 )
 
 
@@ -108,11 +113,17 @@ class FleetCollector:
         log_every: int = 1,
         latency_hists: tuple[str, ...] = LATENCY_HISTS,
         clock: Callable[[], float] = time.monotonic,
+        critpath=None,
     ) -> None:
         self.backend = backend
         self.period_s = float(period_s)
         self.runlog = runlog
         self.slo = slo
+        # ISSUE 20: the in-process front's attribution analyzer — its
+        # joint (wall x segment) profile answers "segment mix AT a
+        # quantile", which the marginal per-segment registry hists
+        # cannot; behind a Router only those scraped hists exist
+        self.critpath = critpath
         self.log_every = max(1, int(log_every))
         self.latency_hists = tuple(latency_hists)
         self._clock = clock
@@ -143,6 +154,16 @@ class FleetCollector:
                 return h
         return None
 
+    @staticmethod
+    def _seg_hists(reg) -> dict[str, StreamingHistogram]:
+        """The replica's per-segment attribution histograms (ISSUE 20
+        — fed by `CritPathAnalyzer` / `ServeClient._resolve`); empty
+        on an unattributed replica."""
+        if reg is None:
+            return {}
+        return {seg: h for seg, name in SEG_HIST.items()
+                if (h := reg.hists.get(name)) is not None}
+
     # -- scrape --------------------------------------------------------
 
     def maybe_scrape(self, now: float | None = None
@@ -164,6 +185,7 @@ class FleetCollector:
 
         rows: list[dict[str, Any]] = []
         fleet_hist: StreamingHistogram | None = None
+        fleet_segs: dict[str, StreamingHistogram] = {}
         fleet = {"decisions": 0.0, "quarantines": 0.0, "dt_s": 0.0,
                  "replicas_alive": 0, "replicas": len(samples)}
         max_version = max(
@@ -180,6 +202,13 @@ class FleetCollector:
                     fleet_hist = wh
                 else:
                     fleet_hist.merge(wh)
+            for seg, sh in (rows[-1].pop("_window_segs", None)
+                            or {}).items():
+                fh = fleet_segs.get(seg)
+                if fh is None:
+                    fleet_segs[seg] = sh
+                else:
+                    fh.merge(sh)
 
         dt = fleet.pop("dt_s")
         window = {
@@ -192,12 +221,14 @@ class FleetCollector:
                 (r["params_lag"] for r in rows
                  if r["params_lag"] is not None), default=None,
             ),
+            "attribution": self._attribution(fleet_segs),
         }
         alerts: list[dict[str, Any]] = []
         if self.slo is not None:
             alerts = self.slo.ingest(window, now=t)
             self.stats["collector_alerts"] += len(alerts)
 
+        att = window["attribution"]
         status = {
             "t": t,
             "replicas": rows,
@@ -209,15 +240,59 @@ class FleetCollector:
                     if fleet_hist is not None and fleet_hist.count
                     else None),
                 "params_version_max": max_version,
+                "tail_seg": (att or {}).get("dominant_tail_segment"),
+                "attribution": att,
             },
             "alerts": alerts,
         }
         self.last_status = status
+        if self.critpath is not None:
+            # idle-tail exemplar shipping: the reservoir flushes on
+            # the scrape cadence even when no new request arrives to
+            # trigger it from the serve path
+            self.critpath.maybe_flush_window()
         if (self.runlog is not None
                 and self.stats["collector_scrapes"] % self.log_every
                 == 0):
             self.runlog.fleet(**_json_safe(status))
         return status
+
+    def _attribution(
+        self, segs: dict[str, StreamingHistogram]
+    ) -> dict[str, Any] | None:
+        """The fleet window's attribution block: windowed per-segment
+        p99/mean over the merged replica histograms, the dominant
+        tail segment, and — when the in-process analyzer is attached
+        — the joint segment mix at p50 vs p99 (cumulative, not
+        windowed: the joint cells have no delta algebra)."""
+        att: dict[str, Any] = {}
+        if segs:
+            p99 = {s: round(h.quantile(0.99), 3)
+                   for s, h in segs.items() if h.count}
+            att = {
+                "n": max(h.count for h in segs.values()),
+                "seg_p99_ms": p99,
+                "seg_mean_ms": {
+                    s: round(h.total / h.count, 3)
+                    for s, h in segs.items() if h.count
+                },
+                "dominant_tail_segment": max(
+                    p99.items(), key=lambda kv: kv[1])[0]
+                if p99 else None,
+            }
+        if self.critpath is not None:
+            prof = self.critpath.profile
+            for q, label in ((0.5, "at_p50"), (0.99, "at_p99")):
+                mix = prof.attribution_at(q)
+                if mix is not None:
+                    att[label] = mix
+            dom = prof.dominant_segment()
+            if dom is not None:
+                # the joint profile's verdict beats the marginal
+                # argmax (the p99 of a segment is not the segment of
+                # the p99 request)
+                att["dominant_tail_segment"] = dom
+        return att or None
 
     def _row(self, s: dict[str, Any], t: float, max_version: int,
              fleet: dict[str, Any]) -> dict[str, Any]:
@@ -225,11 +300,13 @@ class FleetCollector:
         stats = s.get("stats")
         reg = s.get("registry")
         hist = self._latency_hist(reg)
+        segs = self._seg_hists(reg)
         prev = self._prev.get(rep)
         cur = {
             "t": t,
             "stats": dict(stats) if stats else None,
             "hist": hist.copy() if hist is not None else None,
+            "segs": {k: h.copy() for k, h in segs.items()} or None,
         }
         self._prev[rep] = cur
 
@@ -250,7 +327,9 @@ class FleetCollector:
             "ring_occ": _stat(stats, "serve_ring_occupancy"),
             "ring_drains": _stat(stats, "serve_ring_drains"),
             "ring_dropped": _stat(stats, "serve_ring_dropped"),
+            "tail_seg": None,
             "_window_hist": None,
+            "_window_segs": None,
         }
         if row["alive"]:
             fleet["replicas_alive"] += 1
@@ -281,6 +360,21 @@ class FleetCollector:
             row["_window_hist"] = wh
             if wh.count:
                 row["p99_ms"] = round(wh.quantile(0.99), 3)
+        if segs:
+            prev_segs = prev.get("segs") or {}
+            wsegs: dict[str, StreamingHistogram] = {}
+            for seg, h in segs.items():
+                ph = prev_segs.get(seg)
+                ws = h.delta(ph) if ph is not None else h.copy()
+                if ws.count:
+                    wsegs[seg] = ws
+            if wsegs:
+                row["_window_segs"] = wsegs
+                seg_p99 = {seg: round(ws.quantile(0.99), 3)
+                           for seg, ws in wsegs.items()}
+                row["attribution"] = {"seg_p99_ms": seg_p99}
+                row["tail_seg"] = max(
+                    seg_p99.items(), key=lambda kv: kv[1])[0]
         return row
 
     def fleet_status(self) -> dict[str, Any]:
@@ -354,6 +448,7 @@ def render_status(status: dict[str, Any]) -> str:
         f"fleet: alive {fl.get('replicas_alive')}/"
         f"{fl.get('replicas')}  goodput {fl.get('goodput_rps')} rps  "
         f"window p99 {fl.get('window_p99_ms')} ms  "
+        f"tail seg {fl.get('tail_seg')}  "
         f"params vmax {fl.get('params_version_max')}"
     )
     for a in status.get("alerts", []):

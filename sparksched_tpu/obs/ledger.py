@@ -233,6 +233,22 @@ def extract_file(path: str) -> list[Entry]:
             band_source=band[1] if band else "default",
             path=rpath,
         ))
+        # ISSUE 20: a row stamped with an `attribution` block also
+        # indexes its windowed per-segment p99s — a PR that shifts the
+        # tail from device_compute into queue_wait now regresses a
+        # TRACKED metric even when the headline survives
+        att = row.get("attribution")
+        seg_p99 = (att.get("seg_p99_ms")
+                   if isinstance(att, dict) else None)
+        if isinstance(seg_p99, dict):
+            for seg, sv in sorted(seg_p99.items()):
+                if _is_num(sv):
+                    entries.append(Entry(
+                        rnd, fname,
+                        f"{row['metric']}_seg_{seg}_p99_ms",
+                        float(sv), unit="ms",
+                        path=f"{rpath}.attribution.seg_p99_ms.{seg}",
+                    ))
 
     heads: list[tuple[str, str, float]] = []
     _walk_headlines(doc, "", heads)

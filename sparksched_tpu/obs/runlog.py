@@ -343,6 +343,31 @@ class RunLog:
         per-spec cooldown."""
         self.write("alert", slo=slo, **fields)
 
+    def tail_exemplar(self, trace_id: str | None, wall_ms: float,
+                      segments: dict[str, float],
+                      **fields: Any) -> None:
+        """One of the slowest-N requests of an attribution window
+        (ISSUE 20): the critical-path segment decomposition of a
+        concrete tail request (`segments` sums to `wall_ms` exactly —
+        obs/critpath.py `decompose`), plus its tenant/replica/error
+        and its `rank` within the window (0 = slowest). Emitted by
+        `CritPathAnalyzer.flush_window`, so a p99 incident ships
+        traces, not just a number."""
+        self.write(
+            "tail_exemplar", trace_id=trace_id,
+            wall_ms=round(float(wall_ms), 4),
+            segments={k: round(float(v), 4)
+                      for k, v in segments.items()},
+            **fields,
+        )
+
+    def hostprof(self, **tables: Any) -> None:
+        """One role-attributed host-profile dump (ISSUE 20): the
+        per-role self-time tables from `obs.hostprof.HostProfiler`
+        (samples, share, estimated self-ms, top innermost sites per
+        role). Written once at profiler `stop()`."""
+        self.write("hostprof", **tables)
+
     def phase_rank(self, rows: list[dict[str, Any]],
                    source: str | None = None, **fields: Any) -> None:
         """A ranked on-device phase split (ISSUE 17 satellite): the

@@ -167,6 +167,11 @@ class SLOMonitor:
             s.name: [] for s in self.specs
         }
         self._last_alert: dict[str, float] = {}
+        # ISSUE 20: the latest scrape window's attribution block —
+        # stamped on every alert fired from that window, so a
+        # `rollback_on:` decision names the segment that owned the
+        # tail it fired on
+        self._last_attribution: dict[str, Any] | None = None
         self.stats = {"slo_windows": 0, "slo_alerts": 0,
                       "slo_rollbacks": 0}
         self.alerts: list[dict[str, Any]] = []
@@ -179,6 +184,7 @@ class SLOMonitor:
         burn-rate rule. Returns the alerts fired (possibly empty)."""
         t = self._clock() if now is None else float(now)
         self.stats["slo_windows"] += 1
+        self._last_attribution = window.get("attribution")
         for spec in self.specs:
             bad, total = spec.measure(window)
             series = self._series[spec.name]
@@ -252,6 +258,11 @@ class SLOMonitor:
         }
         if rolled_to is not None:
             alert["rolled_back_to_version"] = rolled_to
+        if self._last_attribution:
+            # ISSUE 20: "p99 breached" -> "and queue_wait owns it"
+            alert["attribution"] = self._last_attribution
+            alert["dominant_tail_segment"] = (
+                self._last_attribution.get("dominant_tail_segment"))
         self.alerts.append(alert)
         if self.runlog is not None:
             self.runlog.alert(**alert)

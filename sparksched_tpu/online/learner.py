@@ -128,6 +128,7 @@ class OnlineLearner:
         version0: int = 0,
         runlog=None,
         metrics=None,
+        hostprof=None,
     ) -> None:
         self.trainer = trainer
         self.buffer = buffer
@@ -136,6 +137,9 @@ class OnlineLearner:
         self.swap_every = int(swap_every)
         self.runlog = runlog
         self.metrics = metrics
+        # ISSUE 20: role-attributed host profiler bracketing the
+        # background-learner lifetime (None = never sampled)
+        self.hostprof = hostprof
         self.B = trainer.num_rollouts
         self.T = trainer.rollout_steps
         self.state = trainer.init_state()
@@ -345,6 +349,8 @@ class OnlineLearner:
             target=loop, name="online-learner", daemon=True
         )
         self._thread.start()
+        if self.hostprof is not None and not self.hostprof.running:
+            self.hostprof.start()
 
     def stop(self) -> None:
         if self._thread is None:
@@ -352,6 +358,10 @@ class OnlineLearner:
         self._stop.set()
         self._thread.join(timeout=30.0)
         self._thread = None
+        if self.hostprof is not None and self.hostprof.running:
+            # after the join: the learner's self-time table is
+            # complete, and the `hostprof` record lands post-quiescence
+            self.hostprof.stop()
 
 
 def _fill_lane(dst: np.ndarray, b: int, t: int, src) -> np.ndarray:

@@ -51,6 +51,7 @@ ROLE_NAMES = (
     "serve-client",
     "online-learner",
     "fleet-collector",
+    "host-profiler",
 )
 
 _guard = threading.Lock()

@@ -163,7 +163,7 @@ def _replica_main(conn, idx: int, spec: ReplicaSpec) -> None:
         # the store sees them
         for k in ("host", "port", "replicas", "quota_sessions",
                   "quota_inflight", "collect", "collect_period_s",
-                  "slo"):
+                  "slo", "hostprof"):
             cfg.pop(k, None)
         store = store_from_config(
             cfg, params, bank, scheduler, metrics=registry,

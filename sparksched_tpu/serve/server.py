@@ -58,6 +58,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 from ..config import SERVE_KEYS
+from ..obs.critpath import SEG_HIST, decompose
 from ..obs.tracing import RequestTrace
 from ..ownership import assert_owner
 from .session import (
@@ -160,7 +161,7 @@ class ServeServer:
     def __init__(self, store, front, *, host: str = "127.0.0.1",
                  port: int = 0, quota_sessions: int = 0,
                  quota_inflight: int = 0, metrics=None, runlog=None,
-                 on_poll=None, collector=None,
+                 on_poll=None, collector=None, hostprof=None,
                  op_timeout_s: float = 120.0) -> None:
         self.store = store
         self.front = front
@@ -176,6 +177,10 @@ class ServeServer:
         # (`maybe_scrape` between polls) — the store/Router stays
         # single-owner, no scrape thread near the pipes
         self.collector = collector
+        # ISSUE 20: the role-attributed host profiler brackets the
+        # server's lifetime (start() to stop(), which emits the
+        # `hostprof` runlog record); None = never sampled, zero cost
+        self.hostprof = hostprof
         self.op_timeout_s = float(op_timeout_s)
         self._q: queue.Queue[_Op] = queue.Queue()
         self._stop = threading.Event()
@@ -203,6 +208,8 @@ class ServeServer:
         self._threads = [t_http, t_pump]
         for t in self._threads:
             t.start()
+        if self.hostprof is not None:
+            self.hostprof.start()
         return self
 
     def stop(self) -> None:
@@ -214,6 +221,10 @@ class ServeServer:
         for t in self._threads:
             t.join(timeout=30.0)
         self._threads = []
+        if self.hostprof is not None:
+            # after the join: the tables cover the serve threads'
+            # whole lifetime, and the emit happens post-quiescence
+            self.hostprof.stop()
 
     def __enter__(self) -> "ServeServer":
         return self.start()
@@ -735,6 +746,17 @@ class ServeClient:
                         "serve_span_wire_ms",
                         wire_total - (s["reply"] - s["submit"]) * 1e3,
                     )
+                # ISSUE 20: client-side attribution over the
+                # re-anchored walk — the pure decomposition feeding
+                # the (locked) registry. No analyzer here: resolve
+                # runs on EVERY client worker thread, and the
+                # analyzer is single-owner by design; a 429/transport
+                # failure (wire brackets only) lands its whole wall
+                # in the `wire_submit` segment by the telescoping
+                # rule, which is exactly where a rejected request
+                # spent it.
+                for seg, ms in decompose(s)["segments"].items():
+                    self.metrics.observe(SEG_HIST[seg], ms)
             if self.runlog is not None:
                 self.runlog.trace(
                     tk.trace.trace_id, tk.trace.offsets_ms(),
@@ -800,7 +822,7 @@ def server_from_config(
             "evaluated by the fleet collector's scrape loop)"
         )
 
-    def _attach_collector(backend) -> None:
+    def _attach_collector(backend, front=None) -> None:
         if not collect:
             return
         from ..obs.fleet import FleetCollector
@@ -813,7 +835,20 @@ def server_from_config(
             backend,
             period_s=float(cfg.get("collect_period_s", 1.0)),
             runlog=runlog, slo=monitor,
+            # ISSUE 20: the in-process front's attribution analyzer
+            # enriches the fleet window (a Router backend has none —
+            # its replicas' seg histograms arrive via the scraped
+            # registries instead)
+            critpath=getattr(front, "critpath", None),
         )
+
+    # ISSUE 20: `hostprof: true` brackets the server's lifetime with
+    # the role-attributed sampling profiler (one `hostprof` runlog
+    # record at stop). Default off = never started = zero cost.
+    if bool(cfg.get("hostprof", False)) and "hostprof" not in net_kw:
+        from ..obs.hostprof import HostProfiler
+
+        net_kw["hostprof"] = HostProfiler(runlog=net_kw.get("runlog"))
 
     if replicas > 0:
         from .router import Router
@@ -831,8 +866,13 @@ def server_from_config(
     store_cfg = {k: v for k, v in cfg.items()
                  if k not in ("host", "port", "replicas",
                               "quota_sessions", "quota_inflight",
-                              "collect", "collect_period_s", "slo")}
+                              "collect", "collect_period_s", "slo",
+                              "hostprof")}
     store = store_from_config(store_cfg, params, bank, scheduler)
     front = front_from_config(store_cfg, store)
-    _attach_collector(store)
+    if getattr(front, "critpath", None) is not None:
+        # tail exemplars flow to the server's runlog without turning
+        # on the per-request `trace` record firehose
+        front.critpath.runlog = net_kw.get("runlog")
+    _attach_collector(store, front)
     return ServeServer(store, front, **net_kw)

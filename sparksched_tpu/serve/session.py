@@ -1779,13 +1779,14 @@ class Ticket:
         return self.result is not None or self.error is not None
 
 
-def _finish_ticket(t: Ticket, store: SessionStore, metrics, runlog
-                   ) -> None:
+def _finish_ticket(t: Ticket, store: SessionStore, metrics, runlog,
+                   critpath=None) -> None:
     """Resolve one ticket's instrumentation: merge the store's device
-    spans, stamp `reply`, emit the runlog `trace` record, and feed the
-    per-span histograms. ONE implementation shared by both batching
-    fronts — the paired A/B rows must measure identical ticket
-    accounting."""
+    spans, stamp `reply`, emit the runlog `trace` record, feed the
+    per-span histograms, and (when an attribution analyzer rides the
+    front — ISSUE 20) ingest the trace into `critpath`. ONE
+    implementation shared by both batching fronts — the paired A/B
+    rows must measure identical ticket accounting."""
     m = metrics
     if m is not None:
         m.counter("serve_requests_total")
@@ -1797,6 +1798,11 @@ def _finish_ticket(t: Ticket, store: SessionStore, metrics, runlog
     if t.error is None and spans is not None:
         t.trace.spans.update(spans)
     t.trace.stamp("reply")
+    if critpath is not None:
+        critpath.add(
+            t.trace, tenant=t.session_id,
+            error=None if t.error is None else type(t.error).__name__,
+        )
     if m is not None:
         s = t.trace.spans
         segs = (
@@ -1852,13 +1858,14 @@ class MicroBatcher:
     front_name = "linger"
 
     def __init__(self, store: SessionStore, linger_ms: float = 1.0,
-                 *, metrics=None, runlog=None, trace: bool = False
-                 ) -> None:
+                 *, metrics=None, runlog=None, trace: bool = False,
+                 critpath=None) -> None:
         self.store = store
         self.linger_s = float(linger_ms) / 1e3
         self.metrics = metrics
         self.runlog = runlog
         self.trace = bool(trace)
+        self.critpath = critpath
         self._pending: list[Ticket] = []
 
     def submit(self, sid: int) -> Ticket:
@@ -1887,7 +1894,8 @@ class MicroBatcher:
         return False
 
     def _finish(self, t: Ticket) -> None:
-        _finish_ticket(t, self.store, self.metrics, self.runlog)
+        _finish_ticket(t, self.store, self.metrics, self.runlog,
+                       self.critpath)
 
     def flush(self, reason: str = "forced") -> None:
         """Serve every pending ticket. Duplicate session ids in one
@@ -2034,13 +2042,14 @@ class ContinuousBatcher:
     to wait out)."""
 
     def __init__(self, store: SessionStore, *, metrics=None,
-                 runlog=None, trace: bool = False,
+                 runlog=None, trace: bool = False, critpath=None,
                  pager_aware: bool = True, max_skips: int = 2,
                  depth: int = 1, prefetch: bool = True) -> None:
         self.store = store
         self.metrics = metrics
         self.runlog = runlog
         self.trace = bool(trace)
+        self.critpath = critpath
         self.pager_aware = bool(pager_aware)
         self.max_skips = int(max_skips)
         if depth < 1:
@@ -2106,7 +2115,8 @@ class ContinuousBatcher:
         self._harvest(wait=True)
 
     def _finish(self, t: Ticket) -> None:
-        _finish_ticket(t, self.store, self.metrics, self.runlog)
+        _finish_ticket(t, self.store, self.metrics, self.runlog,
+                       self.critpath)
 
     def _resolve(self, calls: list) -> int:
         """Finalize popped in-flight calls (dispatch order) and
@@ -2469,6 +2479,26 @@ def front_from_config(
     fail loudly."""
     cfg = dict(cfg or {})
     front = str(cfg.get("front", "continuous"))
+    # ISSUE 20: the attribution plane rides the front. Defaults to
+    # the trace setting (traced serving gets attribution for free);
+    # `attribution: false` keeps bare tracing. The overrides value
+    # wins (drivers that build their own analyzer pass critpath=).
+    traced = bool(overrides.get("trace", cfg.get("trace", False)))
+    attribution = bool(cfg.get("attribution", traced))
+    if attribution and not traced:
+        # fail loudly (the serve-config contract): an attribution
+        # plane over untraced tickets would silently observe nothing
+        raise ValueError(
+            "serve: attribution: true requires trace: true (the "
+            "analyzer decomposes the per-request span stamps)"
+        )
+    if attribution and "critpath" not in overrides:
+        from ..obs.critpath import CritPathAnalyzer
+
+        overrides["critpath"] = CritPathAnalyzer(
+            metrics=overrides.get("metrics", store.metrics),
+            runlog=overrides.get("runlog"),
+        )
     if front != "pipelined":
         # fail loudly (the serve-config contract): pipeline knobs on
         # a synchronous front would be silently dropped — the

@@ -1,0 +1,569 @@
+"""Tail-latency attribution plane (ISSUE 20): critical-path segment
+decomposition (additive, sums to wall EXACTLY — in-process, wire
+re-anchored, quarantined, and 429-rejected traces alike), the joint
+wall-bucket x segment profile (attribution AT a quantile), the
+slowest-N exemplar reservoir + `tail_exemplar` runlog emission, the
+fleet collector's per-replica segment windows and dominant-tail-
+segment column, SLO alerts carrying the attribution block, the
+role-attributed host profiler, and the ledger's attribution-segment
+indexing. All synthetic-trace / fake-clock — no store compile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from sparksched_tpu.obs.critpath import (
+    SEG_HIST,
+    SEGMENTS,
+    CritPathAnalyzer,
+    SegmentProfile,
+    decompose,
+)
+from sparksched_tpu.obs.hostprof import (
+    PROFILE_ROLES,
+    HostProfiler,
+    role_of_thread_name,
+)
+from sparksched_tpu.obs.metrics import MetricsRegistry
+from sparksched_tpu.obs.runlog import RunLog
+from sparksched_tpu.obs.tracing import SPAN_ORDER, RequestTrace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _records(path) -> list[dict]:
+    out = []
+    with open(path) as fp:
+        for line in fp:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _sum(segments: dict[str, float]) -> float:
+    return sum(segments.values())
+
+
+# --------------------------------------------------------------------------
+# decompose: the additive-segments invariant, every trace mode
+# --------------------------------------------------------------------------
+
+
+def test_decompose_full_in_process_trace_pins_segments():
+    """The serve pump's full span walk: every gap lands in exactly one
+    segment and the books balance to the wall latency."""
+    t0 = 100.0
+    spans = {
+        "submit": t0,
+        "batch_admit": t0 + 0.001,     # 1 ms queue_wait
+        "dispatch": t0 + 0.003,        # 2 ms batch_form
+        "harvest": t0 + 0.004,         # 1 ms dispatch
+        "device_compute": t0 + 0.024,  # 20 ms device_compute
+        "scatter_back": t0 + 0.027,    # 3 ms harvest...
+        "reply": t0 + 0.029,           # ...+ 2 ms more harvest
+    }
+    dec = decompose(spans)
+    assert dec["first"] == "submit" and dec["last"] == "reply"
+    assert dec["wall_ms"] == pytest.approx(29.0)
+    seg = dec["segments"]
+    assert seg["queue_wait"] == pytest.approx(1.0)
+    assert seg["batch_form"] == pytest.approx(2.0)
+    assert seg["dispatch"] == pytest.approx(1.0)
+    assert seg["device_compute"] == pytest.approx(20.0)
+    # scatter_back -> reply merges into harvest (host materialization)
+    assert seg["harvest"] == pytest.approx(5.0)
+    assert "wire_submit" not in seg and "wire_reply" not in seg
+    assert _sum(seg) == pytest.approx(dec["wall_ms"], abs=1e-9)
+    assert set(seg) <= set(SEGMENTS)
+
+
+def test_decompose_wire_reanchored_trace():
+    """The ServeClient re-anchor: server offsets rebased so `submit`
+    coincides with the client's `wire_submit` stamp — the reply ->
+    wire_reply gap is then the TOTAL network/serialization overhead."""
+    base = 50.0
+    spans = {"wire_submit": base}
+    # server-side ms offsets, re-anchored the way ServeClient._resolve
+    # does: base + offset_ms / 1e3
+    for name, off_ms in (("submit", 0.0), ("batch_admit", 1.0),
+                         ("dispatch", 2.0), ("harvest", 3.0),
+                         ("device_compute", 13.0), ("reply", 15.0)):
+        spans[name] = base + off_ms / 1e3
+    spans["wire_reply"] = base + 19.0 / 1e3
+    dec = decompose(spans)
+    assert dec["wall_ms"] == pytest.approx(19.0)
+    seg = dec["segments"]
+    assert seg["wire_submit"] == pytest.approx(0.0)  # re-anchor: 0
+    assert seg["wire_reply"] == pytest.approx(4.0)
+    assert seg["device_compute"] == pytest.approx(10.0)
+    assert _sum(seg) == pytest.approx(19.0, abs=1e-9)
+
+
+def test_decompose_rejected_and_quarantined_traces():
+    # a 429 / transport error never reaches a server: the client
+    # bracket is the whole trace, and the whole wall is wire_submit
+    dec = decompose({"wire_submit": 10.0, "wire_reply": 10.002})
+    assert dec["segments"] == {
+        "wire_submit": pytest.approx(2.0)}
+    assert dec["wall_ms"] == pytest.approx(2.0)
+    # a quarantined request resolves straight from submit: all
+    # queue_wait (it never formed a batch)
+    dec = decompose({"submit": 5.0, "reply": 5.004})
+    assert dec["segments"] == {"queue_wait": pytest.approx(4.0)}
+    # degenerate traces: zero wall, empty decomposition
+    assert decompose({"submit": 1.0}) == {
+        "wall_ms": 0.0, "segments": {},
+        "first": "submit", "last": "submit"}
+    assert decompose({})["segments"] == {}
+
+
+def test_decompose_ms_offsets_mode_and_unknown_spans():
+    offs = {"submit": 0.0, "dispatch": 2.0, "reply": 7.0,
+            "not_a_span": 99.0}
+    dec = decompose(offs, scale_ms=1.0)
+    assert dec["wall_ms"] == pytest.approx(7.0)
+    assert _sum(dec["segments"]) == pytest.approx(7.0, abs=1e-9)
+    assert "not_a_span" not in dec["segments"]
+
+
+def test_decompose_sums_exactly_for_every_span_subset():
+    """The telescoping guarantee: ANY subset of the span walk with
+    >= 2 boundaries decomposes to segments summing to last - first —
+    the invariant decompose() itself asserts (a violation raises)."""
+    import itertools
+    import random
+
+    rng = random.Random(20)
+    for r in range(2, len(SPAN_ORDER) + 1):
+        for names in itertools.combinations(SPAN_ORDER, r):
+            t, spans = 1000.0, {}
+            for n in names:
+                t += rng.uniform(0.0001, 0.05)
+                spans[n] = t
+            dec = decompose(spans)
+            want = (spans[names[-1]] - spans[names[0]]) * 1e3
+            assert dec["wall_ms"] == pytest.approx(want, abs=1e-9)
+            assert _sum(dec["segments"]) == pytest.approx(
+                dec["wall_ms"], abs=1e-6)
+
+
+def test_front_from_config_attribution_requires_trace():
+    from sparksched_tpu.serve.session import front_from_config
+
+    with pytest.raises(ValueError, match="attribution.*trace"):
+        front_from_config({"attribution": True}, None)
+
+
+# --------------------------------------------------------------------------
+# SegmentProfile: attribution AT a quantile (the joint accounting)
+# --------------------------------------------------------------------------
+
+
+def test_attribution_at_quantile_separates_body_from_tail():
+    """Bimodal load: the body is device-bound, the tail queue-bound.
+    Marginal per-segment p99s cannot see this; the joint profile's
+    p50 mix must be device_compute-dominant and its p99 mix
+    queue_wait-dominant."""
+    prof = SegmentProfile()
+    for i in range(95):
+        prof.add(10.0 + 0.01 * i, {"device_compute": 8.0,
+                                   "queue_wait": 1.0,
+                                   "harvest": 1.0 + 0.01 * i})
+    for i in range(12):
+        prof.add(200.0 + i, {"device_compute": 8.0,
+                             "queue_wait": 190.0 + i,
+                             "harvest": 2.0})
+    at50 = prof.attribution_at(0.5)
+    at99 = prof.attribution_at(0.99)
+    assert at50["n"] >= 8 and at99["n"] >= 8
+    assert max(at50["share"], key=at50["share"].get) \
+        == "device_compute"
+    assert max(at99["share"], key=at99["share"].get) == "queue_wait"
+    assert at99["share"]["queue_wait"] > 0.9
+    # shares are a distribution
+    assert sum(at50["share"].values()) == pytest.approx(1.0, abs=0.01)
+    assert prof.dominant_segment(0.99) == "queue_wait"
+    s = prof.summary()
+    assert s["n"] == 107
+    assert s["dominant_tail_segment"] == "queue_wait"
+    assert s["at_p50"]["q"] == 0.5 and s["at_p99"]["q"] == 0.99
+
+
+def test_attribution_at_quantile_empty_profile():
+    prof = SegmentProfile()
+    assert prof.attribution_at(0.99) is None
+    assert prof.dominant_segment() is None
+    assert prof.summary() == {"n": 0}
+
+
+# --------------------------------------------------------------------------
+# CritPathAnalyzer: ingest, per-key profiles, exemplar reservoir
+# --------------------------------------------------------------------------
+
+
+def _trace(wall_ms: float, t0: float = 10.0,
+           queue_frac: float = 0.1) -> RequestTrace:
+    """An in-process trace with `wall_ms` total: queue_frac of it in
+    queue_wait, the rest in device_compute."""
+    tr = RequestTrace()
+    q = wall_ms * queue_frac / 1e3
+    tr.stamp("submit", t0)
+    tr.stamp("batch_admit", t0 + q)
+    tr.stamp("dispatch", t0 + q)
+    tr.stamp("harvest", t0 + q)
+    tr.stamp("device_compute", t0 + wall_ms / 1e3)
+    tr.stamp("reply", t0 + wall_ms / 1e3)
+    return tr
+
+
+def test_analyzer_feeds_metrics_and_keyed_profiles(tmp_path):
+    reg = MetricsRegistry()
+    cp = CritPathAnalyzer(metrics=reg, window_s=float("inf"))
+    for i in range(10):
+        cp.add(_trace(10.0 + i), tenant=f"t{i % 2}", replica="0")
+    cp.add(_trace(500.0), tenant="t0", replica="1",
+           error="SessionQuarantined")
+    assert cp.stats["critpath_requests"] == 11
+    assert cp.stats["critpath_errors"] == 1
+    # per-segment registry histograms carry every request
+    assert reg.hists[SEG_HIST["device_compute"]].count == 11
+    assert reg.hists[SEG_HIST["queue_wait"]].count == 11
+    snap = cp.snapshot()
+    assert snap["n"] == 11
+    assert snap["dominant_tail_segment"] == "device_compute"
+    assert set(snap["tenants"]) == {"t0", "t1"}
+    assert set(snap["replicas"]) == {"0", "1"}
+    assert snap["replicas"]["1"]["n"] == 1
+    assert snap["replicas"]["1"]["p99_wall_ms"] \
+        == pytest.approx(500.0, rel=0.1)
+
+
+def test_analyzer_key_cardinality_is_bounded():
+    cp = CritPathAnalyzer(max_keys=4, window_s=float("inf"))
+    for i in range(20):
+        cp.add(_trace(10.0), tenant=f"tenant{i}")
+    assert len(cp.by_tenant) == 5  # 4 named + "~other"
+    assert "~other" in cp.by_tenant
+    assert cp.by_tenant["~other"].wall.count == 16
+
+
+def test_exemplar_reservoir_keeps_slowest_and_flushes(tmp_path):
+    clock = [0.0]
+    rl = RunLog(str(tmp_path / "cp.jsonl"))
+    cp = CritPathAnalyzer(runlog=rl, top_n=3, window_s=60.0,
+                          clock=lambda: clock[0])
+    walls = [5.0, 300.0, 7.0, 120.0, 9.0, 250.0, 11.0]
+    for i, w in enumerate(walls):
+        cp.add(_trace(w), tenant=f"t{i}")
+    assert len(cp._exemplars) == 3  # bounded reservoir
+    clock[0] = 61.0  # window elapses -> next observe flushes
+    cp.add(_trace(13.0))
+    rl.close()
+    recs = [r for r in _records(tmp_path / "cp.jsonl")
+            if r.get("ev") == "tail_exemplar"]
+    assert len(recs) == 3
+    # slowest first, rank 0 = slowest; segments balance on each
+    assert [r["rank"] for r in recs] == [0, 1, 2]
+    assert [r["wall_ms"] for r in recs] == [
+        pytest.approx(300.0, rel=0.01),
+        pytest.approx(250.0, rel=0.01),
+        pytest.approx(120.0, rel=0.01)]
+    for r in recs:
+        assert _sum(r["segments"]) \
+            == pytest.approx(r["wall_ms"], abs=0.01)
+        assert r["trace_id"]
+    assert cp.stats["critpath_exemplar_windows"] == 1
+    assert cp.stats["critpath_exemplars"] == 3
+    # the reservoir reset with the window (the 13 ms flusher was
+    # rejected by the full top-3 reservoir before the flush)
+    assert len(cp._exemplars) == 0
+
+
+def test_maybe_flush_window_ships_idle_tail():
+    """The collector's scrape hook: exemplars ship even when no new
+    request arrives after the window elapses."""
+    clock = [0.0]
+    cp = CritPathAnalyzer(top_n=2, window_s=30.0,
+                          clock=lambda: clock[0])
+    cp.add(_trace(100.0))
+    assert cp.maybe_flush_window() == []  # window not yet elapsed
+    clock[0] = 31.0
+    out = cp.maybe_flush_window()  # idle tail: no observe needed
+    assert len(out) == 1 and out[0]["wall_ms"] \
+        == pytest.approx(100.0, rel=0.01)
+    assert cp.maybe_flush_window() == []  # fresh window, empty
+
+
+# --------------------------------------------------------------------------
+# fleet integration: per-replica segment windows + dominant tail column
+# --------------------------------------------------------------------------
+
+
+class _SegFleet:
+    """Router-shaped fake whose registries carry serve_seg_* hists."""
+
+    def __init__(self):
+        self.reg = {r: MetricsRegistry() for r in ("0", "1")}
+        self.stats_by = {
+            r: {"serve_decisions": 0, "serve_quarantines": 0}
+            for r in ("0", "1")
+        }
+
+    def advance(self, rep, decisions, seg_ms):
+        self.stats_by[rep]["serve_decisions"] += decisions
+        for seg, values in seg_ms.items():
+            for v in values:
+                self.reg[rep].observe(SEG_HIST[seg], v)
+                self.reg[rep].observe("serve_span_device_ms", v)
+
+    def replica_samples(self):
+        return [{"replica": r, "alive": True, "sessions": 1,
+                 "registry": self.reg[r],
+                 "stats": dict(self.stats_by[r])}
+                for r in ("0", "1")]
+
+
+def test_fleet_collector_attribution_window_and_tail_seg():
+    from sparksched_tpu.obs.fleet import FleetCollector, render_status
+
+    fake = _SegFleet()
+    t = [100.0]
+    col = FleetCollector(fake, period_s=0.0, clock=lambda: t[0])
+    fake.advance("0", 10, {"device_compute": [5.0] * 10,
+                           "queue_wait": [1.0] * 10})
+    fake.advance("1", 10, {"device_compute": [5.0] * 10})
+    col.scrape()
+
+    # window 2: replica 1 turns queue-bound — its row and the fleet
+    # column must say so, from the WINDOW delta (the cumulative hist
+    # is still device-dominant)
+    fake.advance("0", 10, {"device_compute": [5.0] * 10})
+    fake.advance("1", 10, {"queue_wait": [400.0] * 10,
+                           "device_compute": [5.0] * 10})
+    t[0] += 2.0
+    status = col.scrape()
+    r0, r1 = status["replicas"]
+    assert r0["tail_seg"] == "device_compute"
+    assert r1["tail_seg"] == "queue_wait"
+    assert r1["attribution"]["seg_p99_ms"]["queue_wait"] > 300.0
+    fl = status["fleet"]
+    assert fl["tail_seg"] == "queue_wait"
+    att = fl["attribution"]
+    assert att["dominant_tail_segment"] == "queue_wait"
+    assert att["seg_p99_ms"]["queue_wait"] > 300.0
+    assert att["n"] == 20  # deepest merged window segment hist
+    # renderer shows the dominant tail column
+    assert "tail seg queue_wait" in render_status(status)
+
+
+def test_fleet_collector_drives_analyzer_joint_attribution():
+    """With a critpath analyzer attached (the in-process server path)
+    the fleet attribution block carries the JOINT at_p50/at_p99 mixes
+    and the scrape flushes the exemplar window on an idle tail."""
+    from sparksched_tpu.obs.fleet import FleetCollector
+
+    clock = [0.0]
+    cp = CritPathAnalyzer(window_s=30.0, clock=lambda: clock[0])
+    for i in range(40):
+        cp.add(_trace(10.0, queue_frac=0.1))
+    cp.add(_trace(900.0, queue_frac=0.95))
+
+    class _Store:
+        def __init__(self):
+            self.metrics = MetricsRegistry()
+            self.stats = {"serve_decisions": 41,
+                          "serve_quarantines": 0}
+
+    col = FleetCollector(_Store(), period_s=0.0, critpath=cp,
+                         clock=lambda: clock[0])
+    clock[0] = 31.0
+    status = col.scrape()
+    att = status["fleet"]["attribution"]
+    assert att["dominant_tail_segment"] == "queue_wait"
+    assert att["at_p99"]["share"]["queue_wait"] > 0.5
+    assert max(att["at_p50"]["share"],
+               key=att["at_p50"]["share"].get) == "device_compute"
+    # the scrape flushed the elapsed exemplar window (idle tail)
+    assert cp.stats["critpath_exemplar_windows"] == 1
+
+
+def test_slo_alert_carries_dominant_tail_segment(tmp_path):
+    """Acceptance pin: a seeded latency regression fires an alert that
+    names the segment owning the tail — the pager sees WHY, not just
+    that p99 breached."""
+    from sparksched_tpu.obs.metrics import StreamingHistogram
+    from sparksched_tpu.obs.slo import SLOMonitor, SLOSpec
+
+    def _win(lat_ms, att):
+        h = StreamingHistogram()
+        h.add_many(lat_ms)
+        return {"dt_s": 5.0, "decisions": len(lat_ms),
+                "quarantines": 0, "goodput_rps": len(lat_ms) / 5.0,
+                "latency_hist": h, "attribution": att}
+
+    mon = SLOMonitor([SLOSpec("p99_ms", "latency", 100.0)],
+                     windows=((60.0, 15.0, 2.0),), clock=lambda: 0.0)
+    healthy = {"dominant_tail_segment": "device_compute",
+               "seg_p99_ms": {"device_compute": 50.0}}
+    t = 0.0
+    for _ in range(12):
+        t += 5.0
+        assert mon.ingest(_win([50.0] * 50, healthy), now=t) == []
+    # regression: the tail goes queue-bound and the bound breaches
+    bad = {"dominant_tail_segment": "queue_wait",
+           "seg_p99_ms": {"queue_wait": 400.0,
+                          "device_compute": 50.0},
+           "at_p99": {"share": {"queue_wait": 0.9,
+                                "device_compute": 0.1}}}
+    t += 5.0
+    alerts = mon.ingest(_win([450.0] * 200, bad), now=t)
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["slo"] == "p99_ms"
+    assert a["dominant_tail_segment"] == "queue_wait"
+    assert a["attribution"]["seg_p99_ms"]["queue_wait"] \
+        == pytest.approx(400.0)
+
+
+# --------------------------------------------------------------------------
+# host profiler: role attribution, lifecycle, zero-cost-off
+# --------------------------------------------------------------------------
+
+
+def test_role_of_thread_name_pins_the_role_model():
+    assert role_of_thread_name("MainThread") == "main"
+    assert role_of_thread_name("serve-pump") == "serve-pump"
+    assert role_of_thread_name("serve-client-3") == "serve-client"
+    assert role_of_thread_name("serve-replica-1") == "serve-replica"
+    assert role_of_thread_name("host-profiler") == "host-profiler"
+    assert role_of_thread_name("ThreadPoolExecutor-0_0") == "other"
+    # the profile vocabulary embeds the ownership role model
+    from sparksched_tpu.ownership import ROLE_NAMES
+
+    assert set(ROLE_NAMES) < set(PROFILE_ROLES)
+    assert "host-profiler" in ROLE_NAMES
+
+
+def test_hostprof_attributes_samples_to_roles(tmp_path):
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            sum(range(200))
+
+    worker = threading.Thread(target=spin, name="serve-pump",
+                              daemon=True)
+    worker.start()
+    rl = RunLog(str(tmp_path / "prof.jsonl"))
+    prof = HostProfiler(hz=400.0, runlog=rl, top_n=3)
+    assert not prof.running
+    prof.start()
+    assert prof.start() is prof  # idempotent
+    assert prof.running
+    time.sleep(0.25)
+    tables = prof.stop()
+    stop.set()
+    worker.join(timeout=5.0)
+    rl.close()
+    assert not prof.running
+    assert tables["samples"] > 10
+    assert "serve-pump" in tables["roles"]
+    pump = tables["roles"]["serve-pump"]
+    assert pump["samples"] > 0 and 0.0 < pump["share"] <= 1.0
+    assert pump["top"] and all(
+        ":" in site["site"] for site in pump["top"])
+    assert len(pump["top"]) <= 3
+    # the sampler never samples itself
+    assert "host-profiler" not in tables["roles"]
+    (rec,) = [r for r in _records(tmp_path / "prof.jsonl")
+              if r.get("ev") == "hostprof"]
+    assert rec["samples"] == tables["samples"]
+    assert "serve-pump" in rec["roles"]
+
+
+def test_hostprof_zero_cost_off(tmp_path):
+    """A never-started profiler owns no thread and emits nothing."""
+    rl = RunLog(str(tmp_path / "off.jsonl"))
+    before = threading.active_count()
+    prof = HostProfiler(runlog=rl)
+    assert threading.active_count() == before
+    tables = prof.stop()  # idempotent on a never-started profiler
+    assert tables["samples"] == 0 and tables["roles"] == {}
+    rl.close()
+    assert [r for r in _records(tmp_path / "off.jsonl")
+            if r.get("ev") == "hostprof"] == []
+
+
+# --------------------------------------------------------------------------
+# ledger: attribution-segment indexing + the runpy-warning fix
+# --------------------------------------------------------------------------
+
+
+def test_ledger_indexes_attribution_segment_p99s(tmp_path):
+    from sparksched_tpu.obs.ledger import Ledger
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    (art / "bench_tpu_r21_serve.json").write_text(json.dumps({
+        "rows": [{
+            "metric": "serve_scale_offered50rps_cb",
+            "value": 49.0, "unit": "decisions/s",
+            "attribution": {
+                "seg_p99_ms": {"device_compute": 40.0,
+                               "queue_wait": 9.5},
+                "dominant_tail_segment": "device_compute",
+            },
+        }],
+    }))
+    led = Ledger.scan(root=str(tmp_path))
+    by_metric = {e.metric: e for e in led.entries}
+    dev = by_metric[
+        "serve_scale_offered50rps_cb_seg_device_compute_p99_ms"]
+    assert dev.value == pytest.approx(40.0) and dev.unit == "ms"
+    assert by_metric[
+        "serve_scale_offered50rps_cb_seg_queue_wait_p99_ms"
+    ].value == pytest.approx(9.5)
+    # the headline row still indexes alongside
+    assert by_metric["serve_scale_offered50rps_cb"].value \
+        == pytest.approx(49.0)
+
+
+def test_ledger_module_runs_without_runpy_warning(tmp_path):
+    """The `python -m sparksched_tpu.obs.ledger` entry must not trip
+    runpy's double-import RuntimeWarning (the obs package no longer
+    imports the ledger eagerly — PEP 562 lazy attributes)."""
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    (art / "bench_tpu_r01_x.json").write_text(json.dumps({
+        "rows": [{"metric": "m", "value": 1.0, "unit": "steps/s"}]}))
+    proc = subprocess.run(
+        [sys.executable, "-W", "error::RuntimeWarning",
+         "-m", "sparksched_tpu.obs.ledger", "--root", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RuntimeWarning" not in proc.stderr
+
+
+def test_obs_lazy_attributes_resolve():
+    """The lazy obs exports resolve and __dir__ advertises them."""
+    import sparksched_tpu.obs as obs
+
+    assert obs.CritPathAnalyzer is CritPathAnalyzer
+    assert obs.decompose is decompose
+    assert obs.HostProfiler is HostProfiler
+    for name in ("FleetCollector", "Ledger", "SegmentProfile"):
+        assert getattr(obs, name) is not None
+        assert name in dir(obs)
+    with pytest.raises(AttributeError):
+        obs.not_an_export
